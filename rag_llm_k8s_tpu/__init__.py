@@ -1,0 +1,32 @@
+"""rag_llm_k8s_tpu — a TPU-native RAG-LLM serving framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capability surface of
+``oscka/rag-llm-k8s`` (reference: ``/root/reference``): the reference's CPU
+``transformers`` + SentenceTransformer + faiss stack behind a Flask server
+(``llm/rag.py``) becomes
+
+- a Flax Llama-3.1-8B-Instruct with weights TP-sharded over the ICI mesh
+  (``models/llama.py``, ``parallel/sharding.py``),
+- an XLA-compiled prefill + KV-cached decode engine with continuous batching
+  (``engine/``),
+- a Pallas brute-force kNN kernel over HBM-resident chunk embeddings replacing
+  ``faiss.IndexFlatL2`` (``ops/knn.py``, ``index/store.py``),
+- a Flax bge-m3 (XLM-R) encoder replacing ``SentenceTransformer`` (``models/bge_m3.py``),
+- a C++ byte-level BPE tokenizer replacing HF's Rust tokenizers (``tokenizer/``),
+- the same HTTP surface — ``/upload_pdf``, ``/generate`` (alias ``/query``),
+  ``/index_info`` — plus ``/healthz`` and ``/metrics`` (``server/``).
+
+Subpackage map (SURVEY.md §7):
+    core/      mesh + dtype policy + typed config (reference constants as defaults)
+    ops/       Pallas kernels: kNN top-k, flash attention, decode attention
+    parallel/  sharding rules, collective helpers, ring attention (SP)
+    models/    Flax Llama-3.1, bge-m3 encoder, safetensors loaders
+    engine/    prefill/decode loop, sampling, KV cache, continuous batching
+    index/     device-resident vector store with atomic persistence
+    rag/       chunking, PDF extraction, prompt assembly, pipeline
+    tokenizer/ BPE (Python + C++ native)
+    server/    Flask app (route parity with llm/rag.py)
+    utils/     logging, timing, atomic file IO
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,28 @@
+"""Core runtime: typed configuration, device mesh construction, dtype policy."""
+
+from rag_llm_k8s_tpu.core.config import (
+    AppConfig,
+    DTypePolicy,
+    EncoderConfig,
+    EngineConfig,
+    LlamaConfig,
+    MeshConfig,
+    RetrievalConfig,
+    SamplingConfig,
+    ServerConfig,
+)
+from rag_llm_k8s_tpu.core.mesh import MeshContext, make_mesh
+
+__all__ = [
+    "AppConfig",
+    "DTypePolicy",
+    "EncoderConfig",
+    "EngineConfig",
+    "LlamaConfig",
+    "MeshConfig",
+    "MeshContext",
+    "RetrievalConfig",
+    "SamplingConfig",
+    "ServerConfig",
+    "make_mesh",
+]

@@ -1,0 +1,333 @@
+"""Typed configuration for the whole framework.
+
+The reference scatters its configuration across env vars and hardcoded constants
+(survey: /root/reference/llm/rag.py:18-20,35-39,114,164,172; llm/download_model.py:5,14-25;
+web/app.py:5). Here every knob lives in one dataclass tree; the defaults reproduce the
+reference's behavior exactly, and ``AppConfig.from_env()`` applies the same env-var
+overrides the reference supports (``MODEL_PATH``, ``LLM_SERVICE_URL``, ``HF_TOKEN``)
+plus TPU-specific ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# dtype policy
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DTypePolicy:
+    """TPU dtype policy: bf16 storage/compute, fp32 accumulation and logits.
+
+    The MXU natively multiplies bf16 with fp32 accumulation; keeping weights and
+    activations in bf16 halves HBM traffic (the usual TPU bottleneck) vs the
+    reference's fp32-on-CPU (rag.py:24 loads fp32 ⇒ ~32 GB).
+    """
+
+    param_dtype: jnp.dtype = jnp.bfloat16
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    accum_dtype: jnp.dtype = jnp.float32
+    logits_dtype: jnp.dtype = jnp.float32
+
+    @classmethod
+    def fp32(cls) -> "DTypePolicy":
+        """Full-precision policy for CPU-hosted numerics tests."""
+        return cls(
+            param_dtype=jnp.float32,
+            compute_dtype=jnp.float32,
+            accum_dtype=jnp.float32,
+            logits_dtype=jnp.float32,
+        )
+
+
+# ---------------------------------------------------------------------------
+# mesh
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh over the TPU slice's ICI links.
+
+    Axes (in order): ``dp`` (data parallel, batched concurrent requests),
+    ``sp`` (sequence/context parallel — ring attention), ``tp`` (tensor
+    parallel — the core sharding for Llama-3.1-8B over a v5e-8).
+
+    The reference has no parallelism at all (survey §2c: replicas=1, one CPU
+    process); here TP over ICI is the default and dp/sp are first-class.
+    ``tp = -1`` means "all remaining devices".
+    """
+
+    dp: int = 1
+    sp: int = 1
+    tp: int = -1
+    axis_names: Tuple[str, str, str] = ("dp", "sp", "tp")
+
+    def resolved(self, n_devices: int) -> Tuple[int, int, int]:
+        dp, sp, tp = self.dp, self.sp, self.tp
+        if tp == -1:
+            known = dp * sp
+            if n_devices % known != 0:
+                raise ValueError(
+                    f"n_devices={n_devices} not divisible by dp*sp={known}"
+                )
+            tp = n_devices // known
+        if dp * sp * tp != n_devices:
+            raise ValueError(
+                f"mesh {dp}x{sp}x{tp} != n_devices={n_devices}"
+            )
+        return dp, sp, tp
+
+
+# ---------------------------------------------------------------------------
+# model configs
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RopeScalingConfig:
+    """Llama-3.1 NTK-by-parts RoPE scaling (matches HF ``rope_type="llama3"``)."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    """Llama-family decoder config.
+
+    Defaults are Meta-Llama-3.1-8B-Instruct — the model the reference stages into
+    the PVC and serves (download_model.py:5,17-20; rag.py:24).
+    """
+
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    rope_scaling: Optional[RopeScalingConfig] = field(default_factory=RopeScalingConfig)
+    max_seq_len: int = 131072
+    tie_word_embeddings: bool = False
+    # token ids from Llama-3.1-8B-Instruct generation_config / config.json
+    bos_token_id: int = 128000
+    eos_token_ids: Tuple[int, ...] = (128001, 128008, 128009)
+
+    @classmethod
+    def llama_3_1_8b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def llama_3_2_1b(cls) -> "LlamaConfig":
+        """Llama-3.2-1B — a real family member that fits a single v5e chip in bf16."""
+        return cls(
+            hidden_size=2048,
+            intermediate_size=8192,
+            num_layers=16,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=64,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 256) -> "LlamaConfig":
+        """Miniature config for CPU tests: same code paths, toy shapes."""
+        return cls(
+            vocab_size=vocab_size,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            rope_scaling=None,
+            max_seq_len=256,
+            bos_token_id=1,
+            eos_token_ids=(2,),
+        )
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    """Bidirectional encoder config for the embedding model.
+
+    Defaults are BAAI/bge-m3 (XLM-RoBERTa-large backbone) — the embedder the
+    reference instantiates via SentenceTransformer (rag.py:33) with 1024-d
+    L2-normalized dense vectors (rag.py:55,60).
+    """
+
+    vocab_size: int = 250002
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    max_position_embeddings: int = 8194
+    type_vocab_size: int = 1
+    layer_norm_eps: float = 1e-5
+    pad_token_id: int = 1
+    # XLM-R position ids start at pad_token_id + 1 for real tokens
+    position_offset: int = 2
+    embed_dim: int = 1024  # output dense-vector dimension (CLS pooled)
+    max_encode_len: int = 8192
+
+    @classmethod
+    def bge_m3(cls) -> "EncoderConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 256) -> "EncoderConfig":
+        return cls(
+            vocab_size=vocab_size,
+            hidden_size=32,
+            intermediate_size=64,
+            num_layers=2,
+            num_heads=4,
+            max_position_embeddings=128,
+            embed_dim=32,
+            max_encode_len=64,
+        )
+
+
+# ---------------------------------------------------------------------------
+# retrieval / sampling / engine / server
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RetrievalConfig:
+    """Retrieval behavior; defaults replicate the reference exactly.
+
+    chunk_size/overlap: rag.py:39 (word chunks of 1000, stride 800);
+    k: rag.py:114 (search top-5); context_top_n: rag.py:164 (top-3 into the
+    prompt); metric: embeddings are L2-normalized (rag.py:55) and searched by
+    L2 (rag.py:61) which is monotone in cosine (L2² = 2 − 2·cos).
+    """
+
+    chunk_size: int = 1000
+    chunk_overlap: int = 200
+    k: int = 5
+    context_top_n: int = 3
+    embed_dim: int = 1024
+    metric: str = "l2"  # "l2" | "cosine" — identical ranking on unit vectors
+
+
+@dataclass(frozen=True)
+class SamplingConfig:
+    """Generation parameters; defaults replicate rag.py:172 exactly
+    (max_new_tokens=150, temperature=0.7, top_p=0.9, sampling enabled by the
+    model's bundled generation_config)."""
+
+    max_new_tokens: int = 150
+    temperature: float = 0.7
+    top_p: float = 0.9
+    do_sample: bool = True
+    seed: int = 0
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Serving-engine shape limits (no reference equivalent — the reference
+    re-runs full HF generate per request, single-threaded)."""
+
+    max_batch_size: int = 8
+    # bucketed prompt lengths: each request pads to the next bucket so XLA
+    # compiles a fixed, reusable executable per bucket instead of per-request
+    prompt_buckets: Tuple[int, ...] = (256, 512, 1024, 2048, 4096)
+    max_seq_len: int = 4096 + 256
+    # decode loop emits this many tokens per jitted call (chunked decode)
+    decode_chunk: int = 32
+
+
+@dataclass(frozen=True)
+class ServerConfig:
+    """HTTP surface + storage paths; parity with rag.py:18-20,204 and
+    web/app.py:5."""
+
+    host: str = "0.0.0.0"
+    port: int = 5001
+    model_path: str = "/models"
+    index_path: str = "/models/tpu_index"
+    pdf_dir: str = "/pdfs"
+    embedder_path: str = "/models/bge-m3"
+
+
+# ---------------------------------------------------------------------------
+# top-level
+# ---------------------------------------------------------------------------
+
+SYSTEM_MESSAGE = (
+    "You are a helpful assistant. Answer the user's question based ONLY on the "
+    "given context.\nIf the context doesn't contain relevant information to the "
+    "specific question, say 'I don't have enough information to answer that "
+    "specific question.'\nDo not make up information or use general knowledge "
+    "outside of the given context."
+)
+"""Verbatim parity with the reference's SYSTEM_MESSAGE (rag.py:35-37)."""
+
+
+@dataclass(frozen=True)
+class AppConfig:
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    dtypes: DTypePolicy = field(default_factory=DTypePolicy)
+    model: LlamaConfig = field(default_factory=LlamaConfig.llama_3_1_8b)
+    encoder: EncoderConfig = field(default_factory=EncoderConfig.bge_m3)
+    retrieval: RetrievalConfig = field(default_factory=RetrievalConfig)
+    sampling: SamplingConfig = field(default_factory=SamplingConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    server: ServerConfig = field(default_factory=ServerConfig)
+    system_message: str = SYSTEM_MESSAGE
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "AppConfig":
+        """Build config applying the reference's env-var surface plus TPU knobs.
+
+        ``MODEL_PATH`` — rag.py:18; ``TPU_RAG_*`` — new framework overrides.
+        """
+        env = dict(os.environ if env is None else env)
+        cfg = cls()
+        server = cfg.server
+        if "MODEL_PATH" in env:
+            mp = env["MODEL_PATH"]
+            server = dataclasses.replace(
+                server,
+                model_path=mp,
+                index_path=os.path.join(mp, "tpu_index"),
+                embedder_path=os.path.join(mp, "bge-m3"),
+            )
+        if "TPU_RAG_INDEX_PATH" in env:
+            server = dataclasses.replace(server, index_path=env["TPU_RAG_INDEX_PATH"])
+        if "TPU_RAG_PDF_DIR" in env:
+            server = dataclasses.replace(server, pdf_dir=env["TPU_RAG_PDF_DIR"])
+        if "TPU_RAG_PORT" in env:
+            server = dataclasses.replace(server, port=int(env["TPU_RAG_PORT"]))
+        mesh = cfg.mesh
+        if "TPU_RAG_MESH" in env:
+            # e.g. "dp=2,tp=4" or "tp=8"
+            spec = env["TPU_RAG_MESH"]
+            try:
+                kv = dict(p.split("=", 1) for p in spec.split(","))
+                overrides = {k: int(v) for k, v in kv.items() if k in ("dp", "sp", "tp")}
+            except (ValueError, TypeError) as e:
+                raise ValueError(
+                    f"TPU_RAG_MESH={spec!r} is not of the form 'dp=N,sp=N,tp=N'"
+                ) from e
+            mesh = dataclasses.replace(mesh, **overrides)
+        sampling = cfg.sampling
+        if "TPU_RAG_MAX_NEW_TOKENS" in env:
+            sampling = dataclasses.replace(
+                sampling, max_new_tokens=int(env["TPU_RAG_MAX_NEW_TOKENS"])
+            )
+        return dataclasses.replace(cfg, server=server, mesh=mesh, sampling=sampling)

@@ -1,0 +1,93 @@
+"""Core config + mesh tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from rag_llm_k8s_tpu.core import AppConfig, LlamaConfig, MeshConfig, RetrievalConfig, SamplingConfig
+from rag_llm_k8s_tpu.core.config import SYSTEM_MESSAGE
+from rag_llm_k8s_tpu.core.mesh import make_mesh, single_device_mesh
+
+
+class TestReferenceParityDefaults:
+    """Defaults must reproduce the reference's hardcoded constants (SURVEY §5 config)."""
+
+    def test_retrieval_defaults(self):
+        r = RetrievalConfig()
+        assert r.chunk_size == 1000  # rag.py:39
+        assert r.chunk_overlap == 200  # rag.py:39
+        assert r.k == 5  # rag.py:114
+        assert r.context_top_n == 3  # rag.py:164
+        assert r.embed_dim == 1024  # bge-m3 dim, rag.py:60
+
+    def test_sampling_defaults(self):
+        s = SamplingConfig()
+        assert s.max_new_tokens == 150  # rag.py:172
+        assert s.temperature == 0.7
+        assert s.top_p == 0.9
+
+    def test_server_defaults(self):
+        c = AppConfig()
+        assert c.server.port == 5001  # rag.py:204
+        assert c.server.model_path == "/models"  # rag.py:18
+        assert c.server.pdf_dir == "/pdfs"  # rag.py:20
+
+    def test_system_message_parity(self):
+        assert "based ONLY on the given context" in SYSTEM_MESSAGE
+        assert "I don't have enough information" in SYSTEM_MESSAGE
+
+    def test_llama_8b_architecture(self):
+        m = LlamaConfig.llama_3_1_8b()
+        assert m.hidden_size == 4096
+        assert m.num_layers == 32
+        assert m.num_kv_heads == 8
+        assert m.vocab_size == 128256
+        assert m.rope_theta == 500000.0
+        assert m.rope_scaling.factor == 8.0
+
+    def test_from_env_model_path(self):
+        c = AppConfig.from_env({"MODEL_PATH": "/tmp/m", "TPU_RAG_PORT": "8080"})
+        assert c.server.model_path == "/tmp/m"
+        assert c.server.index_path == "/tmp/m/tpu_index"
+        assert c.server.port == 8080
+
+    def test_from_env_mesh(self):
+        c = AppConfig.from_env({"TPU_RAG_MESH": "dp=2,tp=4"})
+        assert c.mesh.dp == 2 and c.mesh.tp == 4
+
+
+class TestMesh:
+    def test_resolved_auto_tp(self):
+        assert MeshConfig(dp=2, sp=1, tp=-1).resolved(8) == (2, 1, 4)
+        assert MeshConfig().resolved(8) == (1, 1, 8)
+        with pytest.raises(ValueError):
+            MeshConfig(dp=3, sp=1, tp=-1).resolved(8)
+
+    def test_make_mesh_shapes(self, devices8):
+        ctx = make_mesh(MeshConfig(dp=2, sp=1, tp=4), devices=devices8)
+        assert ctx.dp == 2 and ctx.sp == 1 and ctx.tp == 4
+        assert ctx.n_devices == 8
+
+    def test_sharded_matmul_over_tp(self, mesh_tp8):
+        """A TP-sharded matmul must produce identical numerics to unsharded."""
+        k = jax.random.PRNGKey(0)
+        x = jax.random.normal(k, (16, 64), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(1), (64, 128), jnp.float32)
+        ws = jax.device_put(w, mesh_tp8.sharding(None, "tp"))
+        xs = jax.device_put(x, mesh_tp8.replicated)
+
+        @jax.jit
+        def f(x, w):
+            return x @ w
+
+        out = f(xs, ws)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w), rtol=1e-4, atol=1e-4)
+        # output stays sharded over tp on its last dim
+        assert out.sharding.spec == P(None, "tp")
+
+    def test_single_device_mesh(self):
+        ctx = single_device_mesh()
+        assert ctx.n_devices == 1
+        assert ctx.tp == 1
