@@ -246,9 +246,8 @@ class EngineConfig:
     # bucketed prompt lengths: each request pads to the next bucket so XLA
     # compiles a fixed, reusable executable per bucket instead of per-request
     prompt_buckets: Tuple[int, ...] = (256, 512, 1024, 2048, 4096)
+    # hard cap on prompt bucket + generated tokens (KV-cache budget)
     max_seq_len: int = 4096 + 256
-    # decode loop emits this many tokens per jitted call (chunked decode)
-    decode_chunk: int = 32
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,6 @@
+"""Serving engine: XLA-compiled prefill + KV-cached decode, sampling, batching."""
+
+from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+from rag_llm_k8s_tpu.engine.sampling import sample_token
+
+__all__ = ["InferenceEngine", "sample_token"]

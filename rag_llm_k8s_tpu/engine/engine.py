@@ -1,0 +1,301 @@
+"""The inference engine: bucketed prefill + while-loop decode, compiled once
+per (batch, bucket) shape.
+
+Replaces the reference's per-request ``model.generate`` on CPU torch
+(/root/reference/llm/rag.py:172). Design, TPU-first:
+
+- **Static shapes, bucketed prompts**: a prompt pads LEFT to the next bucket
+  (``EngineConfig.prompt_buckets``); XLA compiles one executable per
+  (batch_bucket, prompt_bucket, max_new) triple and reuses it for every
+  request — no per-request recompiles, no dynamic shapes.
+- **Left padding** keeps every sequence's write frontier at the same cache
+  index, so cache appends stay ``dynamic_update_slice`` (survey §7 hard part
+  (b): KV layout under pjit without per-request recompiles).
+- **The whole generate call is ONE compiled function**: prefill (last-token
+  logits only), the ``lax.while_loop`` over decode steps, sampling, and EOS
+  tracking all live on device; the host sees only final token ids. With
+  params placed via NamedSharding, XLA propagates TP shardings through the
+  loop and inserts ICI collectives.
+- **AOT compilation**: executables are built with ``jit(...).lower().compile()``
+  from abstract shapes, so ``warmup()`` pays compile time only — no throwaway
+  generations (readiness gating for the server).
+- **Early exit**: the while_loop stops when every row has emitted EOS —
+  short answers don't pay for ``max_new_tokens`` steps (the reference always
+  runs the full HF sequential loop per request).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rag_llm_k8s_tpu.core.config import (
+    DTypePolicy,
+    EngineConfig,
+    LlamaConfig,
+    SamplingConfig,
+)
+from rag_llm_k8s_tpu.core.mesh import MeshContext
+from rag_llm_k8s_tpu.engine.sampling import sample_token
+from rag_llm_k8s_tpu.models.llama import (
+    LlamaModel,
+    causal_bias,
+    decode_bias,
+    make_kv_cache,
+)
+
+
+def _isin(tokens: jax.Array, ids: Tuple[int, ...]) -> jax.Array:
+    hit = jnp.zeros(tokens.shape, dtype=bool)
+    for i in ids:
+        hit = hit | (tokens == i)
+    return hit
+
+
+@dataclass
+class EngineStats:
+    prefill_tokens: int = 0
+    decode_tokens: int = 0
+    generate_calls: int = 0
+
+
+class InferenceEngine:
+    """Owns params + compiled executables; thread-safe ``generate``."""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params,
+        sampling: SamplingConfig = SamplingConfig(),
+        engine_config: EngineConfig = EngineConfig(),
+        dtypes: DTypePolicy = DTypePolicy(),
+        mesh: Optional[MeshContext] = None,
+        pad_id: int = 0,
+    ):
+        self.config = config
+        self.params = params
+        self.sampling = sampling
+        self.engine_config = engine_config
+        self.dtypes = dtypes
+        self.mesh = mesh
+        self.pad_id = pad_id
+        self.model = LlamaModel(config, dtypes)
+        self._compiled: Dict[Tuple[int, int, int], jax.stages.Compiled] = {}
+        self._lock = threading.Lock()
+        self._rng_counter = 0
+        self.stats = EngineStats()
+
+    # ------------------------------------------------------------------
+    # compiled generate graph (one per (B, S, max_new))
+    # ------------------------------------------------------------------
+    def _build_generate(self, B: int, S: int, max_new: int):
+        cfg, dt, sampling = self.config, self.dtypes, self.sampling
+        model = self.model
+        T = S + max_new
+        eos_ids = cfg.eos_token_ids
+        cache_dtype = dt.compute_dtype
+        pad_id = self.pad_id
+
+        def gen(params, tokens, pad_mask, rng):
+            cache = make_kv_cache(cfg, B, T, cache_dtype)
+            bias = causal_bias(pad_mask, T, 0)
+            real_len = jnp.sum(pad_mask, axis=-1)  # [B]
+            positions = jnp.clip(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
+            logits, cache = model.apply(
+                {"params": params}, tokens, positions, cache, bias, jnp.int32(0),
+                last_logit_only=True,
+            )
+            rng, k0 = jax.random.split(rng)
+            tok0 = sample_token(k0, logits[:, -1], sampling)
+            done0 = _isin(tok0, eos_ids)
+            out0 = jnp.full((B, max_new), pad_id, jnp.int32).at[:, 0].set(tok0)
+            key_valid0 = (
+                jnp.concatenate(
+                    [pad_mask.astype(bool), jnp.zeros((B, max_new), bool)], axis=1
+                )
+                .at[:, S]
+                .set(True)
+            )
+
+            def cond(c):
+                step, _, _, done, _, _, _ = c
+                return (step < max_new) & ~jnp.all(done)
+
+            def body(c):
+                step, cache, last_tok, done, key_valid, out, rng = c
+                # feed token sampled at step-1: cache slot S+step-1, position real_len+step-1
+                write_index = (S + step - 1).astype(jnp.int32)
+                pos = (real_len + step - 1)[:, None].astype(jnp.int32)
+                bias = decode_bias(key_valid)
+                logits, cache = model.apply(
+                    {"params": params},
+                    last_tok[:, None],
+                    pos,
+                    cache,
+                    bias,
+                    write_index,
+                )
+                rng, k = jax.random.split(rng)
+                tok = sample_token(k, logits[:, 0], sampling)
+                tok = jnp.where(done, jnp.int32(eos_ids[0]), tok)
+                done = done | _isin(tok, eos_ids)
+                out = out.at[:, step].set(tok)
+                key_valid = key_valid.at[:, S + step].set(True)
+                return (step + 1, cache, tok, done, key_valid, out, rng)
+
+            # key_valid slot for each fed token is set before its step runs, so
+            # the fed token attends to itself through the freshly written cache
+            init = (jnp.int32(1), cache, tok0, done0, key_valid0, out0, rng)
+            _, _, _, _, _, out, _ = jax.lax.while_loop(cond, body, init)
+            return out
+
+        # AOT-compile from abstract shapes (no execution)
+        param_avals = jax.tree.map(
+            lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=leaf.sharding)
+            if isinstance(leaf, jax.Array)
+            else jax.ShapeDtypeStruct(np.shape(leaf), np.asarray(leaf).dtype),
+            self.params,
+        )
+        data_sharding = self.mesh.replicated if self.mesh is not None else None
+        tok_aval = jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=data_sharding)
+        rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=data_sharding)
+        return (
+            jax.jit(gen)
+            .lower(param_avals, tok_aval, tok_aval, rng_aval)
+            .compile()
+        )
+
+    def _get_compiled(self, B: int, S: int, max_new: int) -> jax.stages.Compiled:
+        key = (B, S, max_new)
+        with self._lock:
+            fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build_generate(B, S, max_new)
+            with self._lock:
+                self._compiled.setdefault(key, fn)
+                fn = self._compiled[key]
+        return fn
+
+    # ------------------------------------------------------------------
+    # host-side API
+    # ------------------------------------------------------------------
+    def _bucket_len(self, n: int) -> int:
+        for b in self.engine_config.prompt_buckets:
+            if n <= b:
+                return b
+        return self.engine_config.prompt_buckets[-1]
+
+    @staticmethod
+    def _bucket_batch(n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def _clamp_max_new(self, S: int, max_new: int) -> int:
+        """Keep S + max_new within the engine's cache budget."""
+        budget = self.engine_config.max_seq_len - S
+        return max(1, min(max_new, budget))
+
+    def _next_rng(self, seed: Optional[int]) -> jax.Array:
+        """Fresh randomness per call unless the caller pins a seed."""
+        if seed is not None:
+            return jax.random.PRNGKey(seed)
+        with self._lock:
+            self._rng_counter += 1
+            counter = self._rng_counter
+        return jax.random.fold_in(jax.random.PRNGKey(self.sampling.seed), counter)
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new_tokens: Optional[int] = None,
+        seed: Optional[int] = None,
+    ) -> List[List[int]]:
+        """Generate continuations for a batch of token-id prompts.
+
+        Returns one token list per prompt, truncated at (and excluding) EOS.
+        Batches larger than ``EngineConfig.max_batch_size`` split into
+        sequential sub-batches (order preserved).
+        """
+        if not prompts:
+            return []
+        max_new = (
+            self.sampling.max_new_tokens if max_new_tokens is None else max_new_tokens
+        )
+        if max_new <= 0:
+            return [[] for _ in prompts]
+
+        cap = self.engine_config.max_batch_size
+        if len(prompts) > cap:
+            out: List[List[int]] = []
+            for i in range(0, len(prompts), cap):
+                out.extend(self.generate(prompts[i : i + cap], max_new_tokens=max_new, seed=seed))
+            return out
+
+        S = self._bucket_len(max(len(p) for p in prompts))
+        B = self._bucket_batch(len(prompts))
+        max_new = self._clamp_max_new(S, max_new)
+
+        tokens = np.full((B, S), self.pad_id, np.int32)
+        pad_mask = np.zeros((B, S), np.int32)
+        for i, p in enumerate(prompts):
+            p = list(p)[-S:]  # truncate from the left if over the largest bucket
+            tokens[i, S - len(p):] = p
+            pad_mask[i, S - len(p):] = 1
+        # empty rows (batch padding) get one BOS so real_len >= 1
+        for i in range(len(prompts), B):
+            tokens[i, -1] = self.config.bos_token_id
+            pad_mask[i, -1] = 1
+
+        fn = self._get_compiled(B, S, max_new)
+        rng = self._next_rng(seed)
+        tokens_j, mask_j, rng_j = self._place_inputs(tokens, pad_mask, rng)
+        out = np.asarray(fn(self.params, tokens_j, mask_j, rng_j))
+
+        results: List[List[int]] = []
+        eos = set(self.config.eos_token_ids)
+        n_decode = 0
+        for i in range(len(prompts)):
+            row = []
+            for t in out[i]:
+                if int(t) in eos:
+                    break
+                row.append(int(t))
+            results.append(row)
+            n_decode += len(row)
+        with self._lock:
+            self.stats.generate_calls += 1
+            self.stats.prefill_tokens += int(pad_mask.sum())
+            self.stats.decode_tokens += n_decode
+        return results
+
+    def _place_inputs(self, tokens: np.ndarray, pad_mask: np.ndarray, rng: jax.Array):
+        """Match the shardings the executable was lowered with."""
+        if self.mesh is None:
+            return jnp.asarray(tokens), jnp.asarray(pad_mask), rng
+        rep = self.mesh.replicated
+        return (
+            jax.device_put(jnp.asarray(tokens), rep),
+            jax.device_put(jnp.asarray(pad_mask), rep),
+            jax.device_put(rng, rep),
+        )
+
+    def warmup(
+        self,
+        batch_sizes: Sequence[int] = (1,),
+        buckets: Optional[Sequence[int]] = None,
+        max_new_tokens: Optional[int] = None,
+    ):
+        """AOT-compile the executables requests will hit — compile time only,
+        nothing executes (readiness gating, survey §5 failure-detection note)."""
+        buckets = buckets or self.engine_config.prompt_buckets
+        max_new = max_new_tokens or self.sampling.max_new_tokens
+        for b in batch_sizes:
+            for s in buckets:
+                self._get_compiled(self._bucket_batch(b), s, self._clamp_max_new(s, max_new))

@@ -1,0 +1,47 @@
+"""Token sampling: greedy and temperature/top-p (nucleus).
+
+Parity target: the reference calls ``model.generate(max_new_tokens=150,
+temperature=0.7, top_p=0.9)`` (/root/reference/llm/rag.py:172), with sampling
+enabled by the model's bundled generation_config. The nucleus rule here matches
+HF's ``TopPLogitsWarper``: keep the smallest descending-probability prefix
+whose cumulative mass reaches ``top_p`` (always at least one token).
+
+Everything is shape-static and branch-free — safe under jit/scan on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from rag_llm_k8s_tpu.core.config import SamplingConfig
+
+NEG_INF = -1e9
+
+
+def top_p_filter(logits: jax.Array, top_p: float) -> jax.Array:
+    """Mask logits outside the nucleus. ``logits: [..., V]`` (any batch dims)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # token i is kept iff the mass strictly before it is < top_p
+    keep_sorted = (cum - probs) < top_p
+    # threshold = smallest kept logit; everything below it is filtered
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits >= threshold, logits, NEG_INF)
+
+
+def sample_token(
+    rng: jax.Array,
+    logits: jax.Array,  # [B, V] fp32
+    sampling: SamplingConfig,
+) -> jax.Array:
+    """One sampling step -> token ids ``[B]`` (int32)."""
+    if not sampling.do_sample or sampling.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / sampling.temperature
+    if sampling.top_p < 1.0:
+        scaled = top_p_filter(scaled, sampling.top_p)
+    return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)

@@ -1,0 +1,5 @@
+"""Flax models: Llama-3.1 decoder family, bge-m3 (XLM-R) encoder, weight loaders."""
+
+from rag_llm_k8s_tpu.models.llama import KVCache, LlamaModel, init_llama_params, make_kv_cache
+
+__all__ = ["KVCache", "LlamaModel", "init_llama_params", "make_kv_cache"]

@@ -1,0 +1,337 @@
+"""Flax Llama-3.x decoder, designed TPU-first.
+
+Replaces the reference's CPU torch path — ``AutoModelForCausalLM.from_pretrained``
++ ``model.generate`` (/root/reference/llm/rag.py:24,172) — with an XLA-native
+implementation:
+
+- **Stacked layers + ``nn.scan``**: all 32 decoder blocks compile as ONE traced
+  block scanned over a leading layer axis, so parameters arrive as ``[L, ...]``
+  arrays (fast compile, trivially sharded, friendly to pjit).
+- **GQA via grouped einsum** (no materialized head repetition): queries reshape
+  to ``[B, S, kv_heads, group, head_dim]`` so the MXU sees large contractions.
+- **One attention path for everything**: training, prefill and decode all write
+  ``K,V`` into a fixed-size cache at ``write_index`` and attend over the whole
+  cache under an additive bias. Static shapes throughout — no data-dependent
+  control flow, so XLA compiles each (batch, bucket) shape exactly once.
+- **bf16 storage/compute, fp32 where it matters**: RMSNorm statistics, RoPE
+  phases, attention logits/softmax and final logits run in fp32
+  (``DTypePolicy``), matching MXU-native mixed precision.
+- **Llama-3.1 RoPE scaling** (NTK-by-parts, HF ``rope_type="llama3"``) so the
+  staged Meta-Llama-3.1-8B-Instruct weights (download_model.py:5,17-25) produce
+  identical positional geometry.
+
+Sharding is NOT baked in here: parameters are plain pytrees; the TP/DP layouts
+live in ``rag_llm_k8s_tpu/parallel/sharding.py`` and are applied by the engine
+via NamedSharding — XLA inserts the ICI collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
+
+# ---------------------------------------------------------------------------
+# KV cache
+# ---------------------------------------------------------------------------
+
+
+@flax.struct.dataclass
+class KVCache:
+    """Per-model KV cache: stacked over layers, written at a shared index.
+
+    Shapes: ``k, v: [L, B, T_max, kv_heads, head_dim]``. Prompts are
+    LEFT-padded by the engine so every sequence in the batch appends at the
+    same ``write_index`` — cache updates stay a ``dynamic_update_slice``
+    (scatter-free, MXU/DMA friendly) instead of a per-row scatter.
+    """
+
+    k: jax.Array
+    v: jax.Array
+
+
+def make_kv_cache(
+    config: LlamaConfig,
+    batch_size: int,
+    max_seq_len: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> KVCache:
+    shape = (
+        config.num_layers,
+        batch_size,
+        max_seq_len,
+        config.num_kv_heads,
+        config.head_dim,
+    )
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+# ---------------------------------------------------------------------------
+# RoPE (Llama-3.1 NTK-by-parts scaling)
+# ---------------------------------------------------------------------------
+
+
+def rope_frequencies(config: LlamaConfig) -> jax.Array:
+    """Per-pair inverse frequencies ``[head_dim // 2]`` in fp32, with the
+    Llama-3.1 wavelength-dependent rescaling applied when configured."""
+    hd = config.head_dim
+    freqs = 1.0 / (
+        config.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
+    )
+    s = config.rope_scaling
+    if s is None:
+        return freqs
+    low_wavelen = s.original_max_position_embeddings / s.low_freq_factor
+    high_wavelen = s.original_max_position_embeddings / s.high_freq_factor
+    wavelen = 2.0 * jnp.pi / freqs
+    # smooth interpolation between scaled and unscaled bands
+    smooth = (s.original_max_position_embeddings / wavelen - s.low_freq_factor) / (
+        s.high_freq_factor - s.low_freq_factor
+    )
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    scaled = (1.0 - smooth) * freqs / s.factor + smooth * freqs
+    return jnp.where(
+        wavelen < high_wavelen, freqs, jnp.where(wavelen > low_wavelen, freqs / s.factor, scaled)
+    )
+
+
+def rope_cos_sin(
+    positions: jax.Array, inv_freqs: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """``positions [B, S] -> cos, sin [B, S, head_dim // 2]`` (fp32)."""
+    phase = positions.astype(jnp.float32)[..., None] * inv_freqs[None, None, :]
+    return jnp.cos(phase), jnp.sin(phase)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate ``x [B, S, H, head_dim]`` pairwise-by-halves (HF llama layout:
+    the rotation pairs dim ``i`` with dim ``i + head_dim/2``)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[:, :, None, :].astype(x1.dtype)
+    s = sin[:, :, None, :].astype(x1.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# modules
+# ---------------------------------------------------------------------------
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    dtypes: DTypePolicy
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), self.dtypes.param_dtype)
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(var + self.eps)
+        return (y * scale.astype(jnp.float32)).astype(self.dtypes.compute_dtype)
+
+
+class Attention(nn.Module):
+    config: LlamaConfig
+    dtypes: DTypePolicy
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,  # [B, S, D]
+        kv: Tuple[jax.Array, jax.Array],  # layer cache [B, T, K, hd] ×2
+        bias: jax.Array,  # [B, 1, S, T] additive fp32 mask
+        cos: jax.Array,
+        sin: jax.Array,
+        write_index: jax.Array,  # scalar int32
+    ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+        c, dt = self.config, self.dtypes
+        B, S, D = x.shape
+        H, K, hd = c.num_heads, c.num_kv_heads, c.head_dim
+        G = H // K
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=dt.compute_dtype, param_dtype=dt.param_dtype, name=name
+        )
+        q = dense(H * hd, "wq")(x).reshape(B, S, H, hd)
+        k = dense(K * hd, "wk")(x).reshape(B, S, K, hd)
+        v = dense(K * hd, "wv")(x).reshape(B, S, K, hd)
+
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        k_cache, v_cache = kv
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, write_index, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, write_index, 0, 0))
+
+        # grouped-query attention: [B,S,K,G,hd] x [B,T,K,hd] -> [B,K,G,S,T]
+        qg = q.reshape(B, S, K, G, hd)
+        scores = jnp.einsum(
+            "bskgd,btkd->bkgst", qg, k_cache, preferred_element_type=jnp.float32
+        )
+        scores = scores * (hd ** -0.5) + bias[:, :, None, :, :]  # [B,1,1,S,T] broadcast
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        out = jnp.einsum(
+            "bkgst,btkd->bskgd", probs.astype(dt.compute_dtype), v_cache,
+            preferred_element_type=jnp.float32,
+        )
+        out = out.astype(dt.compute_dtype).reshape(B, S, H * hd)
+        return dense(D, "wo")(out), (k_cache, v_cache)
+
+
+class MLP(nn.Module):
+    config: LlamaConfig
+    dtypes: DTypePolicy
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c, dt = self.config, self.dtypes
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=dt.compute_dtype, param_dtype=dt.param_dtype, name=name
+        )
+        gate = dense(c.intermediate_size, "w_gate")(x)
+        up = dense(c.intermediate_size, "w_up")(x)
+        return dense(c.hidden_size, "w_down")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    config: LlamaConfig
+    dtypes: DTypePolicy
+
+    @nn.compact
+    def __call__(self, h, kv, bias, cos, sin, write_index):
+        attn_out, kv = Attention(self.config, self.dtypes, name="attn")(
+            RMSNorm(self.config.rms_norm_eps, self.dtypes, name="input_norm")(h),
+            kv, bias, cos, sin, write_index,
+        )
+        h = h + attn_out
+        h = h + MLP(self.config, self.dtypes, name="mlp")(
+            RMSNorm(self.config.rms_norm_eps, self.dtypes, name="post_attn_norm")(h)
+        )
+        return h, kv
+
+
+class LlamaModel(nn.Module):
+    """The full decoder. One call signature for training, prefill and decode:
+
+    ``(tokens [B,S], positions [B,S], cache, bias [B,1,S,T], write_index)``
+    → ``(logits [B,S,V] fp32, new_cache)``.
+
+    - training / logit-eval: ``T == S``, ``write_index = 0``, causal bias;
+    - prefill: bucketed ``S``, ``T = max_seq``, ``write_index = 0``;
+    - decode: ``S = 1``, ``write_index = t``.
+    """
+
+    config: LlamaConfig
+    dtypes: DTypePolicy = DTypePolicy()
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,
+        positions: jax.Array,
+        cache: KVCache,
+        bias: jax.Array,
+        write_index: jax.Array,
+        last_logit_only: bool = False,
+    ) -> Tuple[jax.Array, KVCache]:
+        c, dt = self.config, self.dtypes
+        embedding = self.param(
+            "embedding",
+            nn.initializers.normal(stddev=0.02),
+            (c.vocab_size, c.hidden_size),
+            dt.param_dtype,
+        )
+        h = jnp.take(embedding, tokens, axis=0).astype(dt.compute_dtype)
+
+        cos, sin = rope_cos_sin(positions, rope_frequencies(c))
+
+        ScanBlocks = nn.scan(
+            Block,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+            out_axes=0,
+            length=c.num_layers,
+        )
+        h, (new_k, new_v) = ScanBlocks(c, dt, name="layers")(
+            h, (cache.k, cache.v), bias, cos, sin, write_index
+        )
+
+        h = RMSNorm(c.rms_norm_eps, dt, name="final_norm")(h)
+        if last_logit_only:
+            # prefill only consumes the final position — projecting just it
+            # avoids a [B, S, V] fp32 intermediate (S x the FLOPs and HBM)
+            h = h[:, -1:, :]
+        if c.tie_word_embeddings:
+            logits = jnp.einsum(
+                "bsd,vd->bsv", h, embedding.astype(dt.compute_dtype),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            head = self.param(
+                "lm_head",
+                nn.initializers.normal(stddev=0.02),
+                (c.hidden_size, c.vocab_size),
+                dt.param_dtype,
+            )
+            logits = jnp.einsum(
+                "bsd,dv->bsv", h, head.astype(dt.compute_dtype),
+                preferred_element_type=jnp.float32,
+            )
+        return logits.astype(dt.logits_dtype), KVCache(k=new_k, v=new_v)
+
+
+# ---------------------------------------------------------------------------
+# masks + init
+# ---------------------------------------------------------------------------
+
+NEG_INF = -1e9  # large-negative (not -inf: keeps softmax NaN-free on all-masked rows)
+
+
+def causal_bias(
+    pad_mask: jax.Array,  # [B, S] 1 = real token, 0 = pad
+    total_len: int,
+    write_index: int = 0,
+) -> jax.Array:
+    """Additive attention bias ``[B, 1, S, T]`` for a prefill/training call
+    writing S tokens at ``write_index`` into a T-length cache: query i may see
+    cache slots ``<= write_index + i`` that hold real tokens."""
+    B, S = pad_mask.shape
+    q_pos = write_index + jnp.arange(S)[:, None]  # [S, 1]
+    t_pos = jnp.arange(total_len)[None, :]  # [1, T]
+    causal = t_pos <= q_pos  # [S, T]
+    # key slots beyond what's been written are invalid; pads within the
+    # written prefix are masked via the key-side pad mask
+    key_pad = jnp.ones((B, total_len), dtype=bool)
+    key_pad = jax.lax.dynamic_update_slice(key_pad, pad_mask.astype(bool), (0, write_index))
+    ok = causal[None, :, :] & key_pad[:, None, :]
+    return jnp.where(ok, 0.0, NEG_INF).astype(jnp.float32)[:, None, :, :]
+
+
+def decode_bias(
+    key_valid: jax.Array,  # [B, T] bool: slot holds a real (non-pad) token
+) -> jax.Array:
+    """Additive bias ``[B, 1, 1, T]`` for single-token decode."""
+    return jnp.where(key_valid[:, None, None, :], 0.0, NEG_INF).astype(jnp.float32)
+
+
+def init_llama_params(
+    rng: jax.Array,
+    config: LlamaConfig,
+    dtypes: DTypePolicy = DTypePolicy(),
+):
+    """Random-init parameter pytree (tests, benchmarks; real weights come from
+    the safetensors loader in ``models/loader.py``)."""
+    model = LlamaModel(config, dtypes)
+    B, S = 1, 8
+    cache = make_kv_cache(config, B, S, dtypes.compute_dtype)
+    tokens = jnp.zeros((B, S), jnp.int32)
+    positions = jnp.zeros((B, S), jnp.int32)
+    bias = jnp.zeros((B, 1, S, S), jnp.float32)
+    variables = model.init(rng, tokens, positions, cache, bias, jnp.int32(0))
+    return variables["params"]

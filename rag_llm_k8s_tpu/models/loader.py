@@ -1,0 +1,230 @@
+"""Weight loading: HF safetensors → stacked, sharded Flax parameter pytrees.
+
+The reference stages exactly 10 files of Meta-Llama-3.1-8B-Instruct into the
+model PVC (/root/reference/llm/download_model.py:14-25) and loads them with
+``AutoModelForCausalLM.from_pretrained`` (rag.py:24). This loader consumes the
+SAME on-disk layout (``model-0000x-of-00004.safetensors`` + config/tokenizer
+files) but materializes each tensor directly as a device array with its
+NamedSharding — weights stream HBM-ward shard by shard, never building the
+whole fp32 model on host (the reference needs ~32 GB host RAM for that).
+
+Name mapping (HF → framework; torch ``nn.Linear`` stores ``[out, in]`` so all
+kernels transpose):
+
+    model.embed_tokens.weight                  -> embedding            [V, D]
+    model.layers.{i}.self_attn.q_proj.weight   -> layers.attn.wq.kernel[i]  (T)
+    model.layers.{i}.self_attn.k_proj.weight   -> layers.attn.wk.kernel[i]  (T)
+    model.layers.{i}.self_attn.v_proj.weight   -> layers.attn.wv.kernel[i]  (T)
+    model.layers.{i}.self_attn.o_proj.weight   -> layers.attn.wo.kernel[i]  (T)
+    model.layers.{i}.mlp.gate_proj.weight      -> layers.mlp.w_gate.kernel[i] (T)
+    model.layers.{i}.mlp.up_proj.weight        -> layers.mlp.w_up.kernel[i]   (T)
+    model.layers.{i}.mlp.down_proj.weight      -> layers.mlp.w_down.kernel[i] (T)
+    model.layers.{i}.input_layernorm.weight    -> layers.input_norm.scale[i]
+    model.layers.{i}.post_attention_layernorm.weight -> layers.post_attn_norm.scale[i]
+    model.norm.weight                          -> final_norm.scale
+    lm_head.weight                             -> lm_head              (T; absent when tied)
+
+Layer-indexed entries stack into ``[L, ...]`` arrays matching the ``nn.scan``
+parameter layout of :class:`~rag_llm_k8s_tpu.models.llama.LlamaModel`.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
+
+_LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)$")
+
+# HF suffix -> (framework path under layers/, transpose?)
+_LAYER_MAP = {
+    "self_attn.q_proj.weight": (("attn", "wq", "kernel"), True),
+    "self_attn.k_proj.weight": (("attn", "wk", "kernel"), True),
+    "self_attn.v_proj.weight": (("attn", "wv", "kernel"), True),
+    "self_attn.o_proj.weight": (("attn", "wo", "kernel"), True),
+    "mlp.gate_proj.weight": (("mlp", "w_gate", "kernel"), True),
+    "mlp.up_proj.weight": (("mlp", "w_up", "kernel"), True),
+    "mlp.down_proj.weight": (("mlp", "w_down", "kernel"), True),
+    "input_layernorm.weight": (("input_norm", "scale"), False),
+    "post_attention_layernorm.weight": (("post_attn_norm", "scale"), False),
+}
+
+_TOP_MAP = {
+    "model.embed_tokens.weight": (("embedding",), False),
+    "model.norm.weight": (("final_norm", "scale"), False),
+    "lm_head.weight": (("lm_head",), True),
+}
+
+
+def _to_numpy(t) -> np.ndarray:
+    """torch tensor / numpy array -> numpy (torch bf16 upcasts to fp32; the
+    framework casts back to its param dtype at placement)."""
+    if isinstance(t, np.ndarray):
+        return t
+    if hasattr(t, "detach"):  # torch tensor (tests convert HF models directly)
+        t = t.detach()
+        if "bfloat16" in str(t.dtype):
+            t = t.float()
+        return t.cpu().numpy()
+    return np.asarray(t)
+
+
+def convert_hf_state_dict(
+    state_dict,
+    config: LlamaConfig,
+    dtypes: DTypePolicy = DTypePolicy(),
+    put: Optional[Callable[[tuple, np.ndarray], jax.Array]] = None,
+) -> dict:
+    """Convert a flat HF llama state dict into the framework's param pytree.
+
+    ``state_dict`` is any mapping with ``keys()`` and ``__getitem__`` —
+    a plain dict (tests) or :class:`_LazyStateDict` (production). Conversion
+    is TARGET-driven: each framework parameter pulls exactly the HF tensors it
+    needs, stacks, places, and frees them — host peak memory is one stacked
+    layer group, never the whole checkpoint.
+
+    ``put(path, array)`` controls device placement (e.g. ``device_put`` with a
+    NamedSharding looked up from ``parallel.sharding``); default is host->
+    default-device with dtype cast to ``dtypes.param_dtype``.
+    """
+    if put is None:
+        put = lambda path, arr: jnp.asarray(arr, dtype=dtypes.param_dtype)  # noqa: E731
+
+    L = config.num_layers
+
+    # -- validate the key surface up front (names only, no tensor loads) ----
+    names = set(state_dict.keys())
+    expected = set(_TOP_MAP)
+    if config.tie_word_embeddings:
+        expected.discard("lm_head.weight")
+    for i in range(L):
+        for suffix in _LAYER_MAP:
+            expected.add(f"model.layers.{i}.{suffix}")
+    unknown = {
+        n for n in names - expected if not n.endswith("rotary_emb.inv_freq")
+    }
+    if unknown:
+        raise KeyError(f"unrecognized HF params: {sorted(unknown)[:5]} ...")
+    missing = expected - names
+    if config.tie_word_embeddings:
+        missing.discard("lm_head.weight")
+    if missing:
+        raise ValueError(f"missing HF params: {sorted(missing)[:5]} ...")
+
+    def assign(tree: dict, path: tuple, value):
+        node = tree
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = value
+
+    params: dict = {}
+
+    for name, (path, transpose) in _TOP_MAP.items():
+        if name == "lm_head.weight" and config.tie_word_embeddings:
+            continue
+        arr = _to_numpy(state_dict[name])
+        if transpose:
+            arr = arr.T
+        assign(params, path, put(path, arr))
+        del arr
+
+    for suffix, (sub_path, transpose) in _LAYER_MAP.items():
+        path = ("layers",) + sub_path
+        layers = []
+        for i in range(L):
+            arr = _to_numpy(state_dict[f"model.layers.{i}.{suffix}"])
+            layers.append(arr.T if transpose else arr)
+        stacked = np.stack(layers, axis=0)
+        del layers
+        assign(params, path, put(path, stacked))
+        del stacked
+
+    return params
+
+
+class _LazyStateDict:
+    """Mapping over safetensors shards that loads one tensor at a time.
+
+    ``items()`` yields tensors in on-disk order but each array is read only
+    when yielded and can be freed by the consumer — peak host memory is one
+    stacked parameter group (~4 GB bf16 for an 8B MLP stack), not the whole
+    checkpoint (~16 GB). The reference, by contrast, materializes the full
+    fp32 model on host (rag.py:24 ⇒ the README's 64 GB node floor).
+    """
+
+    def __init__(self, files):
+        from safetensors import safe_open
+
+        self._index: Dict[str, str] = {}
+        self._safe_open = safe_open
+        for f in files:
+            with safe_open(f, framework="np") as reader:
+                for name in reader.keys():
+                    self._index[name] = f
+
+    def keys(self):
+        return self._index.keys()
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        with self._safe_open(self._index[name], framework="np") as reader:
+            return reader.get_tensor(name)
+
+
+def load_safetensors_params(
+    model_dir: str,
+    config: LlamaConfig,
+    dtypes: DTypePolicy = DTypePolicy(),
+    put: Optional[Callable[[tuple, np.ndarray], jax.Array]] = None,
+) -> dict:
+    """Read every ``*.safetensors`` shard under ``model_dir`` (the PVC layout
+    staged by download_model.py) and build the sharded param tree, streaming
+    tensor-by-tensor to device."""
+    files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {model_dir}")
+    return convert_hf_state_dict(_LazyStateDict(files), config, dtypes, put=put)
+
+
+def config_from_hf_json(model_dir: str) -> LlamaConfig:
+    """Build a LlamaConfig from the staged ``config.json``
+    (download_model.py:15 stages it alongside the weights)."""
+    import json
+
+    from rag_llm_k8s_tpu.core.config import RopeScalingConfig
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf = json.load(f)
+    rs = hf.get("rope_scaling") or None
+    rope_scaling = None
+    if rs and rs.get("rope_type", rs.get("type")) == "llama3":
+        rope_scaling = RopeScalingConfig(
+            factor=rs["factor"],
+            low_freq_factor=rs["low_freq_factor"],
+            high_freq_factor=rs["high_freq_factor"],
+            original_max_position_embeddings=rs["original_max_position_embeddings"],
+        )
+    eos = hf.get("eos_token_id", 128009)
+    eos = tuple(eos) if isinstance(eos, (list, tuple)) else (eos,)
+    return LlamaConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf.get("head_dim", hf["hidden_size"] // hf["num_attention_heads"]),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+        rope_theta=hf.get("rope_theta", 500000.0),
+        rope_scaling=rope_scaling,
+        max_seq_len=hf.get("max_position_embeddings", 131072),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        bos_token_id=hf.get("bos_token_id", 128000),
+        eos_token_ids=eos,
+    )

@@ -1,0 +1,9 @@
+"""Parallelism: TP sharding rules, collective helpers, ring attention (SP)."""
+
+from rag_llm_k8s_tpu.parallel.sharding import (
+    llama_param_specs,
+    shard_llama_params,
+    shard_params,
+)
+
+__all__ = ["llama_param_specs", "shard_llama_params", "shard_params"]
