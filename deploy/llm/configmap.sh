@@ -1,0 +1,5 @@
+#!/bin/sh
+# Create the download-script ConfigMap the initContainer mounts (parity with
+# the reference's flow, README.md's `kubectl create configmap` step).
+kubectl create configmap download-script-configmap \
+  --from-file=download_model.py="$(dirname "$0")/download_model.py"

@@ -1,0 +1,58 @@
+"""Model staging — the reference's download_model.py flow kept intact
+(/root/reference/llm/download_model.py:4-33 stages 10 named
+Meta-Llama-3.1-8B-Instruct files into /models), extended to ALSO stage the
+bge-m3 embedder: the reference downloads bge-m3 from the hub at every pod
+boot (rag.py:33 — survey §3.1 flags the boot-time network dependency); here
+it stages once into the PVC like the LLM weights, so pods start offline.
+"""
+
+import os
+
+from huggingface_hub import hf_hub_download
+
+HF_TOKEN = os.environ.get("HF_TOKEN")
+MODEL_DIR = os.environ.get("MODEL_PATH", "/models")
+
+LLAMA_REPO = "meta-llama/Meta-Llama-3.1-8B-Instruct"
+# same 10-file list as the reference (download_model.py:14-25)
+LLAMA_FILES = [
+    "config.json",
+    "generation_config.json",
+    "model-00001-of-00004.safetensors",
+    "model-00002-of-00004.safetensors",
+    "model-00003-of-00004.safetensors",
+    "model-00004-of-00004.safetensors",
+    "model.safetensors.index.json",
+    "special_tokens_map.json",
+    "tokenizer.json",
+    "tokenizer_config.json",
+]
+
+BGE_REPO = "BAAI/bge-m3"
+BGE_FILES = [
+    "config.json",
+    "model.safetensors",
+    "tokenizer.json",
+    "tokenizer_config.json",
+    "special_tokens_map.json",
+    "sentencepiece.bpe.model",
+]
+
+
+def fetch(repo: str, files, target: str):
+    os.makedirs(target, exist_ok=True)
+    for name in files:
+        print(f"downloading {repo}/{name} -> {target}")
+        hf_hub_download(
+            repo_id=repo, filename=name, local_dir=target, token=HF_TOKEN
+        )
+
+
+def main():
+    fetch(LLAMA_REPO, LLAMA_FILES, MODEL_DIR)
+    fetch(BGE_REPO, BGE_FILES, os.path.join(MODEL_DIR, "bge-m3"))
+    print("staging complete")
+
+
+if __name__ == "__main__":
+    main()

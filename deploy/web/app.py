@@ -1,0 +1,32 @@
+"""Streamlit web UI — behavior parity with /root/reference/web/app.py: a text
+box + Generate button POSTing to the LLM service, rendering generated_text.
+Additions: renders the retrieval context and per-stage timings the TPU server
+returns (the reference drops the 'context' field — web/app.py:15-19)."""
+
+import os
+
+import requests
+import streamlit as st
+
+LLM_SERVICE_URL = os.environ.get("LLM_SERVICE_URL", "http://llm-service:80")
+
+st.title("RAG LLM (TPU)")
+
+prompt = st.text_input("Enter your prompt:")
+if st.button("Generate") and prompt:
+    with st.spinner("Generating..."):
+        resp = requests.post(f"{LLM_SERVICE_URL}/generate", json={"prompt": prompt})
+    if resp.status_code == 200:
+        body = resp.json()
+        st.write(body.get("generated_text", ""))
+        timings = body.get("timings")
+        if timings:
+            st.caption(
+                " | ".join(f"{k}: {v} ms" for k, v in timings.items())
+            )
+        context = body.get("context")
+        if context:
+            with st.expander("Retrieved context"):
+                st.text(context)
+    else:
+        st.error(f"Error {resp.status_code}: {resp.text}")

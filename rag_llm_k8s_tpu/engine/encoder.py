@@ -1,0 +1,71 @@
+"""Batched embedding runner over the Flax bge-m3 encoder.
+
+The reference embeds ONE chunk per ``SentenceTransformer.encode`` call in a
+Python loop (/root/reference/llm/rag.py:55,101,133). Here ingest batches whole
+chunk sets into bucketed device calls (BASELINE.json config #2: the
+"batch embedding (PDF-chunk ingest path)") — right-padded, mask-aware, one
+executable per (batch, length) bucket.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rag_llm_k8s_tpu.core.config import DTypePolicy, EncoderConfig
+from rag_llm_k8s_tpu.core.mesh import MeshContext
+from rag_llm_k8s_tpu.models.bge_m3 import BgeM3Encoder
+from rag_llm_k8s_tpu.utils.buckets import bucket_len, next_pow2
+
+
+class EncoderRunner:
+    def __init__(
+        self,
+        config: EncoderConfig,
+        params,
+        dtypes: DTypePolicy = DTypePolicy(),
+        mesh: Optional[MeshContext] = None,
+        length_buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048, 4096, 8192),
+        max_batch: int = 16,
+    ):
+        self.config = config
+        self.params = params
+        self.dtypes = dtypes
+        self.mesh = mesh
+        self.length_buckets = tuple(
+            b for b in length_buckets if b <= config.max_encode_len
+        ) or (config.max_encode_len,)
+        self.max_batch = max_batch
+        self.model = BgeM3Encoder(config, dtypes)
+        self._jit = jax.jit(
+            lambda params, tokens, mask: self.model.apply(
+                {"params": params}, tokens, mask
+            )
+        )
+
+    def encode(self, token_lists: Sequence[Sequence[int]]) -> np.ndarray:
+        """Token-id sequences → ``[N, hidden]`` fp32 unit vectors."""
+        if not token_lists:
+            return np.zeros((0, self.config.hidden_size), np.float32)
+        out = np.zeros((len(token_lists), self.config.hidden_size), np.float32)
+        # group by length bucket to minimize padding waste
+        order = sorted(range(len(token_lists)), key=lambda i: len(token_lists[i]))
+        for start in range(0, len(order), self.max_batch):
+            group = order[start : start + self.max_batch]
+            S = bucket_len(max(len(token_lists[i]) for i in group), self.length_buckets)
+            B = next_pow2(len(group))
+            pad = self.config.pad_token_id
+            tokens = np.full((B, S), pad, np.int32)
+            mask = np.zeros((B, S), np.int32)
+            for row, i in enumerate(group):
+                ids = list(token_lists[i])[: S]
+                tokens[row, : len(ids)] = ids
+                mask[row, : len(ids)] = 1
+            emb = self._jit(self.params, jnp.asarray(tokens), jnp.asarray(mask))
+            emb = np.asarray(emb)
+            for row, i in enumerate(group):
+                out[i] = emb[row]
+        return out

@@ -48,6 +48,7 @@ from rag_llm_k8s_tpu.models.llama import (
     decode_bias,
     make_kv_cache,
 )
+from rag_llm_k8s_tpu.utils.buckets import bucket_len, next_pow2
 
 
 def _isin(tokens: jax.Array, ids: Tuple[int, ...]) -> jax.Array:
@@ -185,17 +186,11 @@ class InferenceEngine:
     # host-side API
     # ------------------------------------------------------------------
     def _bucket_len(self, n: int) -> int:
-        for b in self.engine_config.prompt_buckets:
-            if n <= b:
-                return b
-        return self.engine_config.prompt_buckets[-1]
+        return bucket_len(n, self.engine_config.prompt_buckets)
 
     @staticmethod
     def _bucket_batch(n: int) -> int:
-        b = 1
-        while b < n:
-            b *= 2
-        return b
+        return next_pow2(n)
 
     def _clamp_max_new(self, S: int, max_new: int) -> int:
         """Keep S + max_new within the engine's cache budget."""

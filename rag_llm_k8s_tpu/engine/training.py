@@ -1,0 +1,62 @@
+"""Training step: causal-LM loss + optax update, shardable over the mesh.
+
+The reference has no training of any kind (survey §5 checkpoint note: its only
+persisted state is weights/index). The framework still ships a real training
+path — fine-tuning the served model on the indexed corpus is the natural
+extension, and the multi-chip dry-run exercises exactly this step end-to-end
+(tp×dp sharded params, dp-sharded batch, XLA-inserted gradient psums).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
+from rag_llm_k8s_tpu.models.llama import LlamaModel, causal_bias, make_kv_cache
+
+
+def lm_loss(
+    model: LlamaModel,
+    params,
+    tokens: jax.Array,  # [B, S]
+    mask: jax.Array,  # [B, S] 1 = real token
+) -> jax.Array:
+    """Next-token cross entropy, fp32, masked mean."""
+    B, S = tokens.shape
+    cache = make_kv_cache(model.config, B, S, model.dtypes.compute_dtype)
+    bias = causal_bias(mask, S, 0)
+    positions = jnp.clip(jnp.cumsum(mask, axis=-1) - 1, 0)
+    logits, _ = model.apply({"params": params}, tokens, positions, cache, bias, jnp.int32(0))
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    targets = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    w = (mask[:, :-1] * mask[:, 1:]).astype(jnp.float32)
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def make_train_step(
+    config: LlamaConfig,
+    dtypes: DTypePolicy = DTypePolicy(),
+    optimizer: Optional[optax.GradientTransformation] = None,
+):
+    """Returns ``(init_opt_state, train_step)``; ``train_step`` is jittable and
+    sharding-transparent: with TP/DP-placed params and dp-sharded batches, XLA
+    emits the ICI collectives (grad psum over dp, activation collectives over
+    tp) — no pmap, no hand-written comms."""
+    model = LlamaModel(config, dtypes)
+    opt = optimizer or optax.adamw(1e-5)
+
+    def init_opt_state(params):
+        return opt.init(params)
+
+    def train_step(params, opt_state, tokens, mask):
+        loss, grads = jax.value_and_grad(lambda p: lm_loss(model, p, tokens, mask))(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init_opt_state, train_step

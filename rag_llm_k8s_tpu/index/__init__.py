@@ -1,0 +1,5 @@
+"""Device-resident vector index with atomic persistence."""
+
+from rag_llm_k8s_tpu.index.store import SearchResult, VectorStore
+
+__all__ = ["SearchResult", "VectorStore"]

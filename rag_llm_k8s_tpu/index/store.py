@@ -1,0 +1,255 @@
+"""Device-resident vector store — the framework's ``faiss.IndexFlatL2`` +
+pickle-metadata replacement, with the reference's concurrency bugs fixed.
+
+Reference behavior being replaced (/root/reference/llm/rag.py):
+- ``IndexFlatL2`` create/add/search/serialize — rag.py:61,80,116,62,82
+- pickled metadata sidecar — rag.py:63-64,82-84
+- **data race**: ``update_index`` is an unlocked read-modify-write of two
+  files, reachable concurrently from ``/upload_pdf`` (rag.py:68-86,141) —
+  fixed here by a single-writer lock around all mutation.
+- **boot duplication**: ingest re-runs on every pod start and unconditionally
+  appends, duplicating every chunk in the persisted index (survey §3.1) —
+  fixed here by content-hash dedup.
+- **non-atomic persistence**: ``faiss.write_index`` + a separate pickle can
+  desync on crash — fixed by write-temp-then-rename of a single snapshot
+  (plus a generation number for observability).
+
+Search runs on device: embeddings live as a padded ``[N_pad, D]`` fp32 array
+(padded so the executable shape only changes when the index outgrows its
+bucket), queried through the fused Pallas kNN kernel on TPU (XLA fallback
+elsewhere) — ``ops/knn.py``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rag_llm_k8s_tpu.ops.knn import BIG, knn_topk
+
+_FORMAT_VERSION = 1
+
+
+@dataclass
+class SearchResult:
+    """One hit: metadata dict + squared-L2 distance (faiss-parity score)."""
+
+    metadata: Dict
+    distance: float
+
+
+def _content_hash(metadata: Dict) -> str:
+    """Dedup key: document identity + chunk text (NOT the embedding — vectors
+    for identical content are regenerated identically by the same encoder;
+    encoder CHANGES are handled by the store-level ``fingerprint``)."""
+    h = hashlib.sha256()
+    h.update(str(metadata.get("filename", "")).encode())
+    h.update(str(metadata.get("chunk_id", "")).encode())
+    h.update(str(metadata.get("text", "")).encode())
+    return h.hexdigest()
+
+
+def _pad_bucket(n: int, minimum: int = 512) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class VectorStore:
+    """Append-only exact-kNN store. Thread-safe: one writer lock serializes
+    mutation + persistence; searches read an immutable device snapshot."""
+
+    def __init__(self, dim: int, path: Optional[str] = None, fingerprint: str = ""):
+        self.dim = dim
+        self.path = path
+        # identifies the embedder that produced the stored vectors; a mismatch
+        # at open time means the index is stale (e.g. swapped encoder weights)
+        self.fingerprint = fingerprint
+        self._lock = threading.RLock()
+        self._vectors = np.zeros((0, dim), np.float32)
+        self._metadata: List[Dict] = []
+        self._hashes: set = set()
+        self.generation = 0
+        # device snapshot (rebuilt lazily after mutation)
+        self._dev: Optional[Tuple[jax.Array, jax.Array]] = None
+
+    # ------------------------------------------------------------------
+    # mutation (single-writer)
+    # ------------------------------------------------------------------
+    def add(
+        self,
+        vectors: Sequence[np.ndarray],
+        metadata: Sequence[Dict],
+        dedup: bool = True,
+    ) -> int:
+        """Append vectors; returns how many were actually added (content-hash
+        duplicates are skipped so boot-time re-ingest is idempotent)."""
+        if len(vectors) != len(metadata):
+            raise ValueError("vectors and metadata length mismatch")
+        with self._lock:  # dedup check and append are one atomic step
+            fresh_v, fresh_m, fresh_h = [], [], []
+            for v, m in zip(vectors, metadata):
+                v = np.asarray(v, np.float32).reshape(-1)
+                if v.shape[0] != self.dim:
+                    raise ValueError(f"vector dim {v.shape[0]} != index dim {self.dim}")
+                h = _content_hash(m)
+                if dedup and (h in self._hashes or h in fresh_h):
+                    continue
+                fresh_v.append(v)
+                fresh_m.append(dict(m))
+                fresh_h.append(h)
+            if not fresh_v:
+                return 0
+            self._vectors = np.concatenate([self._vectors, np.stack(fresh_v)], axis=0)
+            self._metadata.extend(fresh_m)
+            self._hashes.update(fresh_h)
+            self.generation += 1
+            self._dev = None
+        return len(fresh_v)
+
+    # ------------------------------------------------------------------
+    # search (on device)
+    # ------------------------------------------------------------------
+    def _device_snapshot(self) -> Tuple[jax.Array, jax.Array]:
+        with self._lock:
+            if self._dev is not None:
+                return self._dev
+            n = len(self._metadata)
+            n_pad = _pad_bucket(max(n, 1))
+            emb = np.zeros((n_pad, self.dim), np.float32)
+            emb[:n] = self._vectors
+            norms = np.full((1, n_pad), BIG, np.float32)
+            norms[0, :n] = (self._vectors**2).sum(axis=1)
+            self._dev = (jnp.asarray(emb), jnp.asarray(norms))
+            return self._dev
+
+    def search(self, query: np.ndarray, k: int = 5) -> List[SearchResult]:
+        """Exact kNN by squared L2 (parity with rag.py:114-120, including the
+        distance values the reference surfaces as 'score')."""
+        n = len(self._metadata)
+        if n == 0:
+            return []
+        k_eff = min(k, n)
+        emb, norms = self._device_snapshot()
+        q = np.asarray(query, np.float32).reshape(1, self.dim)
+        dists, idx = knn_topk(jnp.asarray(q), emb, norms, k=k_eff)
+        dists, idx = np.asarray(dists[0]), np.asarray(idx[0])
+        return [
+            SearchResult(metadata=self._metadata[int(i)], distance=float(d))
+            for d, i in zip(dists, idx)
+        ]
+
+    # ------------------------------------------------------------------
+    # introspection (parity with GET /index_info, rag.py:183-197)
+    # ------------------------------------------------------------------
+    @property
+    def ntotal(self) -> int:
+        return len(self._metadata)
+
+    def info(self) -> Dict:
+        with self._lock:
+            return {
+                "total_vectors": len(self._metadata),
+                "dimension": self.dim,
+                "total_chunks": len(self._metadata),
+                "sample_chunks": [dict(m) for m in self._metadata[:5]],
+                "generation": self.generation,
+            }
+
+    # ------------------------------------------------------------------
+    # persistence (atomic snapshot; replaces faiss file + pickle sidecar)
+    # ------------------------------------------------------------------
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or self.path
+        if path is None:
+            raise ValueError("no path configured")
+        with self._lock:
+            payload_meta = {
+                "format_version": _FORMAT_VERSION,
+                "dim": self.dim,
+                "count": len(self._metadata),
+                "generation": self.generation,
+                "fingerprint": self.fingerprint,
+                "metadata": self._metadata,
+                "hashes": sorted(self._hashes),
+            }
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            dir_ = os.path.dirname(path) or "."
+            # vectors (npy) and metadata (json), each written tmp-then-rename;
+            # metadata lands LAST and names the vector payload it belongs to,
+            # so a crash between the two renames leaves a consistent pair
+            vec_path = path + ".vectors.npy"
+            fd, tmp = tempfile.mkstemp(dir=dir_, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    np.save(f, self._vectors)
+                os.replace(tmp, vec_path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+            fd, tmp = tempfile.mkstemp(dir=dir_, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(payload_meta, f)
+                os.replace(tmp, path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+        return path
+
+    @classmethod
+    def load(cls, path: str, dim: Optional[int] = None) -> "VectorStore":
+        with open(path) as f:
+            meta = json.load(f)
+        if meta.get("format_version") != _FORMAT_VERSION:
+            raise ValueError(f"unsupported index format: {meta.get('format_version')}")
+        store = cls(dim=meta["dim"], path=path)
+        vectors = np.load(path + ".vectors.npy")
+        count = meta["count"]
+        if vectors.shape[0] < count:
+            raise ValueError(
+                f"index corrupt: metadata says {count} vectors, payload has {vectors.shape[0]}"
+            )
+        store._vectors = np.asarray(vectors[:count], np.float32)
+        store._metadata = list(meta["metadata"])
+        store._hashes = set(meta.get("hashes", []))
+        store.generation = meta.get("generation", 0)
+        store.fingerprint = meta.get("fingerprint", "")
+        if dim is not None and store.dim != dim:
+            raise ValueError(f"index dim {store.dim} != expected {dim}")
+        return store
+
+    @classmethod
+    def open_or_create(
+        cls, path: str, dim: int, fingerprint: Optional[str] = None
+    ) -> "VectorStore":
+        """ensure_index_exists parity (rag.py:57-66): load if present, else
+        create empty (persisted on first save). A persisted index whose
+        embedder fingerprint doesn't match is discarded — its vectors were
+        produced by a different encoder and would silently mis-rank against
+        fresh query embeddings."""
+        if os.path.exists(path):
+            store = cls.load(path, dim=dim)
+            if fingerprint is not None and store.fingerprint != fingerprint:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "index at %s was built by a different embedder "
+                    "(fingerprint %r != %r); rebuilding fresh",
+                    path, store.fingerprint, fingerprint,
+                )
+                return cls(dim=dim, path=path, fingerprint=fingerprint)
+            return store
+        return cls(dim=dim, path=path, fingerprint=fingerprint or "")

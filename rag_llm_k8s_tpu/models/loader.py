@@ -192,6 +192,86 @@ def load_safetensors_params(
     return convert_hf_state_dict(_LazyStateDict(files), config, dtypes, put=put)
 
 
+# ---------------------------------------------------------------------------
+# XLM-R / bge-m3 encoder conversion
+# ---------------------------------------------------------------------------
+
+# HF suffix (under encoder.layer.{i}.) -> framework path under layers/
+_XLMR_LAYER_MAP = {
+    "attention.self.query": ("wq",),
+    "attention.self.key": ("wk",),
+    "attention.self.value": ("wv",),
+    "attention.output.dense": ("wo",),
+    "intermediate.dense": ("w_in",),
+    "output.dense": ("w_out",),
+}
+_XLMR_LAYER_LN = {
+    "attention.output.LayerNorm": ("attn_ln",),
+    "output.LayerNorm": ("ffn_ln",),
+}
+
+
+def convert_xlmr_state_dict(
+    state_dict,
+    config,
+    dtypes: DTypePolicy = DTypePolicy(),
+    put: Optional[Callable[[tuple, np.ndarray], jax.Array]] = None,
+) -> dict:
+    """HF ``XLMRobertaModel`` state dict → :class:`BgeM3Encoder` params.
+
+    Accepts keys with or without a ``roberta.`` prefix; the unused pooler is
+    skipped. Kernel transposes follow torch Linear ``[out, in]`` storage.
+    """
+    if put is None:
+        put = lambda path, arr: jnp.asarray(arr, dtype=dtypes.param_dtype)  # noqa: E731
+
+    # name map only — tensors load lazily one at a time
+    names = {n.removeprefix("roberta."): n for n in state_dict.keys()}
+    L = config.num_layers
+
+    def get(name):
+        return _to_numpy(state_dict[names[name]])
+
+    params: dict = {
+        "word_embeddings": put(("word_embeddings",), get("embeddings.word_embeddings.weight")),
+        "position_embeddings": put(
+            ("position_embeddings",), get("embeddings.position_embeddings.weight")
+        ),
+        "token_type_embeddings": put(
+            ("token_type_embeddings",), get("embeddings.token_type_embeddings.weight")
+        ),
+        "embed_ln": {
+            "scale": put(("embed_ln", "scale"), get("embeddings.LayerNorm.weight")),
+            "bias": put(("embed_ln", "bias"), get("embeddings.LayerNorm.bias")),
+        },
+        "layers": {},
+    }
+    layers: dict = params["layers"]
+    for suffix, sub in _XLMR_LAYER_MAP.items():
+        kernels = [get(f"encoder.layer.{i}.{suffix}.weight").T for i in range(L)]
+        biases = [get(f"encoder.layer.{i}.{suffix}.bias") for i in range(L)]
+        layers[sub[0]] = {
+            "kernel": put(("layers",) + sub + ("kernel",), np.stack(kernels)),
+            "bias": put(("layers",) + sub + ("bias",), np.stack(biases)),
+        }
+    for suffix, sub in _XLMR_LAYER_LN.items():
+        scales = [get(f"encoder.layer.{i}.{suffix}.weight") for i in range(L)]
+        biases = [get(f"encoder.layer.{i}.{suffix}.bias") for i in range(L)]
+        layers[sub[0]] = {
+            "scale": put(("layers",) + sub + ("scale",), np.stack(scales)),
+            "bias": put(("layers",) + sub + ("bias",), np.stack(biases)),
+        }
+    return params
+
+
+def load_encoder_safetensors(model_dir: str, config, dtypes: DTypePolicy = DTypePolicy(), put=None):
+    """Load a bge-m3 / XLM-R checkpoint directory (PVC-staged) into params."""
+    files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {model_dir}")
+    return convert_xlmr_state_dict(_LazyStateDict(files), config, dtypes, put=put)
+
+
 def config_from_hf_json(model_dir: str) -> LlamaConfig:
     """Build a LlamaConfig from the staged ``config.json``
     (download_model.py:15 stages it alongside the weights)."""

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels (with XLA fallbacks): kNN top-k, flash attention."""
+
+from rag_llm_k8s_tpu.ops.knn import knn_topk, knn_topk_pallas, knn_topk_xla
+
+__all__ = ["knn_topk", "knn_topk_pallas", "knn_topk_xla"]

@@ -92,3 +92,18 @@ def shard_params(params, specs, ctx: MeshContext):
 def shard_llama_params(params, ctx: MeshContext):
     """One-call TP placement of a Llama param tree."""
     return shard_params(params, llama_param_specs(params, ctx), ctx)
+
+
+def make_streaming_put(ctx: MeshContext, dtype=None):
+    """A ``put(path, np_array)`` callback for the safetensors loaders: each
+    tensor goes straight from host to its TP shards (never materializing the
+    full model on one device). Casting happens host-side BEFORE the transfer
+    so an fp32 checkpoint doesn't ship double-width bytes over PCIe."""
+
+    def put(path: Tuple[str, ...], arr):
+        if dtype is not None and arr.dtype != dtype:
+            arr = arr.astype(dtype)
+        spec = _fit_spec(_spec_for_path(path, arr.ndim), arr.shape, ctx)
+        return jax.device_put(arr, NamedSharding(ctx.mesh, spec))
+
+    return put

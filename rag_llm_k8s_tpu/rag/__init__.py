@@ -1,0 +1,6 @@
+"""RAG pipeline: chunking, PDF extraction, prompt assembly, retrieve-then-generate."""
+
+from rag_llm_k8s_tpu.rag.chunking import split_text
+from rag_llm_k8s_tpu.rag.prompt import assemble_context, assemble_prompt
+
+__all__ = ["split_text", "assemble_context", "assemble_prompt"]

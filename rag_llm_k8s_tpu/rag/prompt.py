@@ -1,0 +1,53 @@
+"""Prompt assembly — byte parity with the reference's format, plus an
+opt-in Llama-3.1 chat-template mode the reference lacks.
+
+Reference format (/root/reference/llm/rag.py:163-169):
+- context block: ``Document '{filename}' (chunk {chunk_id}, score: {d:.4f}): {text}\\n\\n``
+  for the top-3 results of the k=5 search;
+- full prompt: ``{SYSTEM_MESSAGE}\\n\\nContext: {context}\\n\\nUser: {q}\\n\\nChatbot:``
+  (a plain string — the reference never applies Llama-3.1's chat template even
+  though it serves Instruct weights; ``chat_template=True`` here fixes that
+  while keeping the default identical for parity).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from rag_llm_k8s_tpu.core.config import SYSTEM_MESSAGE
+from rag_llm_k8s_tpu.index.store import SearchResult
+
+
+def assemble_context(results: Sequence[SearchResult], top_n: int = 3) -> str:
+    context = ""
+    for r in results[:top_n]:
+        doc = r.metadata
+        context += (
+            f"Document '{doc.get('filename')}' (chunk {doc.get('chunk_id')}, "
+            f"score: {r.distance:.4f}): {doc.get('text')}\n\n"
+        )
+    return context
+
+
+def assemble_prompt(
+    user_prompt: str,
+    context: str,
+    system_message: str = SYSTEM_MESSAGE,
+    chat_template: bool = False,
+) -> str:
+    if not chat_template:
+        return f"{system_message}\n\nContext: {context}\n\nUser: {user_prompt}\n\nChatbot:"
+    # Llama-3.1 chat format (header tokens are plain text here; the tokenizer
+    # maps them to special ids)
+    return (
+        "<|begin_of_text|><|start_header_id|>system<|end_header_id|>\n\n"
+        f"{system_message}\n\nContext: {context}<|eot_id|>"
+        "<|start_header_id|>user<|end_header_id|>\n\n"
+        f"{user_prompt}<|eot_id|>"
+        "<|start_header_id|>assistant<|end_header_id|>\n\n"
+    )
+
+
+def extract_answer(generated_text: str) -> str:
+    """Parity with rag.py:174: the answer is what follows the last 'Chatbot:'."""
+    return generated_text.split("Chatbot:")[-1].strip()

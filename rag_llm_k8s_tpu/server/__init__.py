@@ -1,0 +1,5 @@
+"""HTTP serving layer (Flask) — route parity with the reference's llm/rag.py."""
+
+from rag_llm_k8s_tpu.server.app import RagService, create_app
+
+__all__ = ["RagService", "create_app"]

@@ -1,0 +1,312 @@
+"""HTTP serving app — the reference's surface, TPU-backed.
+
+(The reference uses Flask; Flask is absent from this environment, so the app
+is built directly on werkzeug — Flask's own WSGI substrate — preserving the
+exact HTTP contract.)
+
+Route parity with /root/reference/llm/rag.py:
+- ``POST /upload_pdf`` (rag.py:122-144): same multipart contract, same success/
+  error JSON and status codes;
+- ``POST /generate`` (rag.py:146-181): same ``{"prompt": ...}`` request, same
+  ``{"generated_text", "context"}`` response (plus an additive ``timings``
+  field), errors → 500 ``{"error"}``. Also served as ``POST /query`` — the
+  name BASELINE.json uses for the same endpoint (SURVEY.md terminology note);
+- ``GET /index_info`` (rag.py:183-197): same payload (+ ``generation``).
+
+New, absent from the reference (survey §5 gaps):
+- ``GET /healthz``: readiness gated on warmed (pre-compiled) executables;
+- ``GET /metrics``: per-stage latency + token counters.
+
+Fixed reference defects (survey §3.1/§5): ingest is idempotent (content-hash
+dedup in the store) so pod restarts don't duplicate the index; index mutation
+is single-writer; persistence is atomic.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from rag_llm_k8s_tpu.core.config import AppConfig
+from rag_llm_k8s_tpu.engine.encoder import EncoderRunner
+from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+from rag_llm_k8s_tpu.index.store import VectorStore
+from rag_llm_k8s_tpu.rag.chunking import split_text
+from rag_llm_k8s_tpu.rag.pdf import extract_text
+from rag_llm_k8s_tpu.rag.prompt import assemble_context, assemble_prompt, extract_answer
+
+logger = logging.getLogger(__name__)
+
+
+class _Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = {}
+
+    def observe(self, name: str, value: float):
+        with self._lock:
+            self.counters[f"{name}_sum"] = self.counters.get(f"{name}_sum", 0.0) + value
+            self.counters[f"{name}_count"] = self.counters.get(f"{name}_count", 0) + 1
+
+    def inc(self, name: str, value: float = 1):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + value
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self.counters)
+
+
+class RagService:
+    """The retrieve-then-generate pipeline behind the routes."""
+
+    def __init__(
+        self,
+        config: AppConfig,
+        engine: InferenceEngine,
+        llm_tokenizer,
+        encoder: EncoderRunner,
+        encoder_tokenizer,
+        store: VectorStore,
+    ):
+        self.config = config
+        self.engine = engine
+        self.llm_tokenizer = llm_tokenizer
+        self.encoder = encoder
+        self.encoder_tokenizer = encoder_tokenizer
+        self.store = store
+        self.metrics = _Metrics()
+        self.ready = False
+
+    # -- embedding ------------------------------------------------------
+    def embed_texts(self, texts: List[str]) -> np.ndarray:
+        limit = self.config.encoder.max_encode_len
+        token_lists = [self.encoder_tokenizer.encode(t)[:limit] for t in texts]
+        return self.encoder.encode(token_lists)
+
+    # -- ingest ---------------------------------------------------------
+    def ingest_pdf_bytes(self, data: bytes, filename: str) -> int:
+        """Extract → chunk → batch-embed → index. Returns chunk count."""
+        t0 = time.monotonic()
+        text = extract_text(data)
+        chunks = split_text(
+            text, self.config.retrieval.chunk_size, self.config.retrieval.chunk_overlap
+        )
+        if not chunks:
+            return 0
+        vectors = self.embed_texts(chunks)
+        metadata = [
+            {"filename": filename, "chunk_id": i, "text": c} for i, c in enumerate(chunks)
+        ]
+        added = self.store.add(list(vectors), metadata)
+        if added and self.store.path:
+            self.store.save()
+        self.metrics.observe("ingest_seconds", time.monotonic() - t0)
+        self.metrics.inc("ingested_chunks", added)
+        logger.info("ingested %s: %d chunks (%d new)", filename, len(chunks), added)
+        return len(chunks)
+
+    def ingest_directory(self, pdf_dir: Optional[str] = None) -> int:
+        """Boot-time ingest parity (rag.py:88-112) — but idempotent."""
+        pdf_dir = pdf_dir or self.config.server.pdf_dir
+        if not os.path.isdir(pdf_dir):
+            logger.warning("No PDF directory at %s", pdf_dir)
+            return 0
+        files = [f for f in sorted(os.listdir(pdf_dir)) if f.endswith(".pdf")]
+        for fname in files:
+            with open(os.path.join(pdf_dir, fname), "rb") as f:
+                self.ingest_pdf_bytes(f.read(), fname)
+        if not files:
+            logger.warning("No PDF files found in %s", pdf_dir)
+        return len(files)
+
+    # -- query ----------------------------------------------------------
+    def answer(self, user_prompt: str) -> Dict:
+        timings: Dict[str, float] = {}
+        t_all = time.monotonic()
+
+        t0 = time.monotonic()
+        qvec = self.embed_texts([user_prompt])[0]
+        timings["embed_ms"] = (time.monotonic() - t0) * 1e3
+
+        t0 = time.monotonic()
+        results = self.store.search(qvec, k=self.config.retrieval.k)
+        timings["retrieve_ms"] = (time.monotonic() - t0) * 1e3
+
+        if not results:
+            return {"generated_text": "No relevant information found in the index."}
+
+        context, prompt_ids = self._budgeted_prompt(user_prompt, results)
+
+        t0 = time.monotonic()
+        out_ids = self.engine.generate([prompt_ids])[0]
+        completion = self.llm_tokenizer.decode(out_ids)
+        timings["generate_ms"] = (time.monotonic() - t0) * 1e3
+        timings["total_ms"] = (time.monotonic() - t_all) * 1e3
+
+        self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
+        self.metrics.inc("query_decode_tokens", len(out_ids))
+        return {
+            "generated_text": extract_answer(completion),
+            "context": context,
+            "timings": {k: round(v, 2) for k, v in timings.items()},
+        }
+
+    def _budgeted_prompt(self, user_prompt: str, results) -> tuple:
+        """Assemble context + prompt ids, shrinking the context until the
+        tokenized prompt fits the engine's largest bucket. Without this, a
+        3×1000-word context can exceed the bucket and the engine would
+        left-truncate away BOS + the system message (degraded answers).
+        Shrink order: drop trailing chunks, then trim the last chunk's words.
+        """
+        budget = max(self.engine.engine_config.prompt_buckets)
+        bos = self.config.model.bos_token_id
+        used = [
+            type(r)(metadata=dict(r.metadata), distance=r.distance)
+            for r in results[: self.config.retrieval.context_top_n]
+        ]
+        while True:
+            context = assemble_context(used, len(used))
+            prompt = assemble_prompt(user_prompt, context, self.config.system_message)
+            ids = self.llm_tokenizer.encode(prompt)
+            if not ids or ids[0] != bos:
+                ids = [bos] + ids
+            if len(ids) <= budget:
+                return context, ids
+            if len(used) > 1:
+                logger.warning("prompt over %d-token budget; dropping chunk %d", budget, len(used))
+                used.pop()
+            else:
+                words = used[0].metadata.get("text", "").split()
+                if len(words) < 40:  # give up: serve what fits via engine truncation
+                    logger.warning("prompt irreducibly over budget; hard truncating")
+                    return context, ids[:1] + ids[1 + (len(ids) - budget):]
+                used[0].metadata["text"] = " ".join(words[: int(len(words) * 0.8)])
+                logger.warning("prompt over budget; trimming last chunk to %d words",
+                               int(len(words) * 0.8))
+
+    # -- lifecycle ------------------------------------------------------
+    def warmup(self):
+        """Pre-compile the hot executables, then mark ready (the reference has
+        no readiness signal; first request pays full compile)."""
+        self.engine.warmup(batch_sizes=(1,), buckets=self.engine.engine_config.prompt_buckets[:2])
+        self.embed_texts(["warmup"])
+        self.ready = True
+
+
+class WsgiApp:
+    """A small WSGI app on werkzeug (Flask's substrate — Flask itself is not
+    available in this environment; the HTTP contract is what matters for
+    parity with the reference's Flask app, and it's preserved exactly)."""
+
+    def __init__(self, service: RagService):
+        import json as _json
+
+        from werkzeug.exceptions import HTTPException, NotFound
+        from werkzeug.routing import Map, Rule
+        from werkzeug.wrappers import Request, Response
+
+        self.service = service
+        self._Request = Request
+        self._Response = Response
+        self._HTTPException = HTTPException
+        self._NotFound = NotFound
+        self._json = _json
+        self.url_map = Map(
+            [
+                Rule("/upload_pdf", endpoint="upload_pdf", methods=["POST"]),
+                Rule("/generate", endpoint="generate", methods=["POST"]),
+                Rule("/query", endpoint="generate", methods=["POST"]),
+                Rule("/index_info", endpoint="index_info", methods=["GET"]),
+                Rule("/healthz", endpoint="healthz", methods=["GET"]),
+                Rule("/metrics", endpoint="metrics", methods=["GET"]),
+            ]
+        )
+
+    # -- helpers --------------------------------------------------------
+    def _jsonify(self, payload, status: int = 200):
+        return self._Response(
+            self._json.dumps(payload), status=status, mimetype="application/json"
+        )
+
+    # -- endpoints ------------------------------------------------------
+    def ep_upload_pdf(self, request):
+        if "file" not in request.files:
+            return self._jsonify({"error": "No file part"}, 400)
+        file = request.files["file"]
+        if file.filename == "":
+            return self._jsonify({"error": "No selected file"}, 400)
+        if file and file.filename.endswith(".pdf"):
+            try:
+                n = self.service.ingest_pdf_bytes(file.read(), file.filename)
+            except Exception as e:  # noqa: BLE001 — parity: any failure → JSON error
+                logger.exception("upload_pdf failed")
+                return self._jsonify({"error": str(e)}, 500)
+            return self._jsonify(
+                {"message": f"PDF processed and indexed successfully. {n} chunks created."}
+            )
+        return self._jsonify({"error": "Invalid file format"}, 400)
+
+    def ep_generate(self, request):
+        try:
+            data = request.get_json(force=True, silent=True) or {}
+            user_prompt = data.get("prompt", "")
+            logger.debug("User query: %s", user_prompt)
+            return self._jsonify(self.service.answer(user_prompt))
+        except Exception as e:  # noqa: BLE001 — parity with rag.py:179-181
+            logger.exception("generate failed")
+            return self._jsonify({"error": str(e)}, 500)
+
+    def ep_index_info(self, request):
+        try:
+            return self._jsonify(self.service.store.info())
+        except Exception as e:  # noqa: BLE001
+            return self._jsonify({"error": str(e)}, 500)
+
+    def ep_healthz(self, request):
+        ready = self.service.ready
+        return self._jsonify({"status": "ok" if ready else "warming"}, 200 if ready else 503)
+
+    def ep_metrics(self, request):
+        snap = self.service.metrics.snapshot()
+        stats = self.service.engine.stats
+        snap.update(
+            {
+                "engine_generate_calls": stats.generate_calls,
+                "engine_prefill_tokens": stats.prefill_tokens,
+                "engine_decode_tokens": stats.decode_tokens,
+                "index_vectors": self.service.store.ntotal,
+            }
+        )
+        return self._jsonify(snap)
+
+    # -- WSGI plumbing --------------------------------------------------
+    def __call__(self, environ, start_response):
+        request = self._Request(environ)
+        adapter = self.url_map.bind_to_environ(environ)
+        try:
+            endpoint, _ = adapter.match()
+            response = getattr(self, f"ep_{endpoint}")(request)
+        except self._HTTPException as e:
+            response = e
+        return response(environ, start_response)
+
+    def test_client(self):
+        from werkzeug.test import Client
+
+        return Client(self)
+
+    def run(self, host: str = "0.0.0.0", port: int = 5001, threaded: bool = True):
+        from werkzeug.serving import run_simple
+
+        run_simple(host, port, self, threaded=threaded)
+
+
+def create_app(service: RagService) -> WsgiApp:
+    return WsgiApp(service)

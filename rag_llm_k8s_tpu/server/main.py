@@ -1,0 +1,103 @@
+"""Production entrypoint: assemble the full service from PVC-staged artifacts.
+
+Boot sequence (parity with rag.py's __main__, rag.py:199-204, plus the fixes
+from survey §5):
+
+1. build the (dp, sp, tp) mesh over the slice's chips;
+2. stream Llama-3.1 safetensors (the exact 10-file layout download_model.py
+   stages) into TP-sharded device arrays;
+3. load the bge-m3 encoder + both tokenizers;
+4. open-or-create the index (idempotent), ingest ``/pdfs``;
+5. AOT-warm the generate/embed executables, THEN mark ready (/healthz);
+6. serve on :5001.
+
+Run: ``python -m rag_llm_k8s_tpu.server.main``
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+logging.basicConfig(level=os.environ.get("TPU_RAG_LOG_LEVEL", "INFO"))
+logger = logging.getLogger(__name__)
+
+
+def build_service():
+    from rag_llm_k8s_tpu.core.config import AppConfig
+    from rag_llm_k8s_tpu.core.mesh import make_mesh
+    from rag_llm_k8s_tpu.engine.encoder import EncoderRunner
+    from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+    from rag_llm_k8s_tpu.index.store import VectorStore
+    from rag_llm_k8s_tpu.models.loader import (
+        config_from_hf_json,
+        load_encoder_safetensors,
+        load_safetensors_params,
+    )
+    from rag_llm_k8s_tpu.parallel.sharding import make_streaming_put
+    from rag_llm_k8s_tpu.server.app import RagService
+    from rag_llm_k8s_tpu.tokenizer import load_tokenizer
+
+    config = AppConfig.from_env()
+    mesh = make_mesh(config.mesh)
+    logger.info("mesh: %s", mesh.mesh)
+
+    model_dir = config.server.model_path
+    model_cfg = config.model
+    if os.path.exists(os.path.join(model_dir, "config.json")):
+        model_cfg = config_from_hf_json(model_dir)
+    logger.info("loading Llama weights from %s", model_dir)
+    params = load_safetensors_params(
+        model_dir, model_cfg, config.dtypes, put=make_streaming_put(mesh, config.dtypes.param_dtype)
+    )
+    llm_tokenizer = load_tokenizer(model_dir)
+
+    logger.info("loading bge-m3 from %s", config.server.embedder_path)
+    enc_params = load_encoder_safetensors(
+        config.server.embedder_path, config.encoder, config.dtypes
+    )
+    enc_tokenizer = load_tokenizer(config.server.embedder_path)
+
+    engine = InferenceEngine(
+        model_cfg,
+        params,
+        sampling=config.sampling,
+        engine_config=config.engine,
+        dtypes=config.dtypes,
+        mesh=mesh,
+    )
+    encoder = EncoderRunner(config.encoder, enc_params, config.dtypes, mesh=mesh)
+
+    # fingerprint the embedder with a probe embedding so a persisted index
+    # built by different encoder weights is detected and rebuilt
+    import hashlib
+
+    probe = encoder.encode([enc_tokenizer.encode("__embedder_fingerprint__")])[0]
+    fingerprint = hashlib.sha256(probe.tobytes()).hexdigest()[:16]
+    store = VectorStore.open_or_create(
+        config.server.index_path, dim=config.retrieval.embed_dim, fingerprint=fingerprint
+    )
+
+    return RagService(config, engine, llm_tokenizer, encoder, enc_tokenizer, store)
+
+
+def main():
+    from rag_llm_k8s_tpu.server.app import create_app
+
+    service = build_service()
+    service.ingest_directory()
+    if service.store.ntotal == 0:
+        logger.warning("No PDF files were processed. The index might be empty.")
+
+    # warm in the background so /healthz can report progress immediately
+    threading.Thread(target=service.warmup, daemon=True).start()
+
+    app = create_app(service)
+    cfg = service.config.server
+    logger.info("serving on %s:%d", cfg.host, cfg.port)
+    app.run(host=cfg.host, port=cfg.port)
+
+
+if __name__ == "__main__":
+    main()

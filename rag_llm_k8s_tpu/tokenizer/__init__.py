@@ -1,0 +1,13 @@
+"""Tokenizers: byte-level BPE (Llama-3) and Unigram (XLM-R / bge-m3), loaded
+from HF ``tokenizer.json`` — replacing the Rust ``tokenizers`` wheel the
+reference uses through ``AutoTokenizer`` (/root/reference/llm/rag.py:25).
+
+A C++ fast path (``rag_llm_k8s_tpu/native``) accelerates the BPE merge loop;
+the pure-Python implementation here is the reference and fallback.
+"""
+
+from rag_llm_k8s_tpu.tokenizer.hf_json import load_tokenizer
+from rag_llm_k8s_tpu.tokenizer.bpe import ByteLevelBPETokenizer
+from rag_llm_k8s_tpu.tokenizer.unigram import UnigramTokenizer
+
+__all__ = ["load_tokenizer", "ByteLevelBPETokenizer", "UnigramTokenizer"]

@@ -1,0 +1,218 @@
+"""Byte-level BPE tokenizer (the Llama-3 / GPT-2 family algorithm).
+
+Loads the exact ``tokenizer.json`` the reference stages into the PVC
+(/root/reference/llm/download_model.py:23) and reproduces HF ``tokenizers``
+(Rust) behavior: byte→unicode remapping, regex pre-tokenization, ranked merge
+loop, special-token splitting. Implemented from the algorithm, not ported —
+see the GPT-2 paper's byte-level BPE description.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Python's `re` lacks \p{L}/\p{N}; translate HF regexes to equivalent
+# unicode-aware classes (letter = \w minus digits/underscore).
+_PCLASS_SUBS = [
+    (r"[^\r\n\p{L}\p{N}]", r"(?:(?!\w)[^\r\n]|_)"),
+    (r"[^\s\p{L}\p{N}]", r"(?:[^\s\w]|_)"),
+    (r"\p{L}", r"[^\W\d_]"),
+    (r"\p{N}", r"\d"),
+]
+
+
+def translate_hf_regex(pattern: str) -> str:
+    for src, dst in _PCLASS_SUBS:
+        pattern = pattern.replace(src, dst)
+    return pattern
+
+
+# GPT-2's byte-level pre-tokenization regex (what a bare ByteLevel
+# pre-tokenizer with use_regex=True applies).
+_GPT2_PATTERN = translate_hf_regex(
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"
+)
+
+# Llama-3's pattern (tokenizer.json carries it in a Split pre-tokenizer; this
+# is the translated default when none is specified).
+_LLAMA3_PATTERN = translate_hf_regex(
+    r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}{1,3}"
+    r"| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+"
+)
+
+
+def _pattern_from_spec(spec: dict) -> str:
+    """Extract + translate the pre-tokenization regex from a tokenizer.json
+    pre_tokenizer section (Split nodes carry explicit regexes; a bare
+    ByteLevel with use_regex implies the GPT-2 pattern)."""
+    pre = spec.get("pre_tokenizer") or {}
+    nodes = pre.get("pretokenizers", [pre]) if pre.get("type") == "Sequence" else [pre]
+    for node in nodes:
+        if node.get("type") == "Split":
+            pat = node.get("pattern", {})
+            if "Regex" in pat:
+                return translate_hf_regex(pat["Regex"])
+    for node in nodes:
+        if node.get("type") == "ByteLevel" and node.get("use_regex", True):
+            return _GPT2_PATTERN
+    return _LLAMA3_PATTERN
+
+
+@functools.lru_cache(maxsize=1)
+def byte_to_unicode() -> Dict[int, str]:
+    """GPT-2's reversible byte→printable-unicode mapping."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(0xA1, 0xAD))
+        + list(range(0xAE, 0x100))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+@functools.lru_cache(maxsize=1)
+def unicode_to_byte() -> Dict[str, int]:
+    return {v: k for k, v in byte_to_unicode().items()}
+
+
+class ByteLevelBPETokenizer:
+    def __init__(
+        self,
+        vocab: Dict[str, int],
+        merges: List[Tuple[str, str]],
+        special_tokens: Optional[Dict[str, int]] = None,
+        pattern: str = _LLAMA3_PATTERN,
+    ):
+        self.vocab = vocab
+        self.id_to_token = {i: t for t, i in vocab.items()}
+        self.ranks: Dict[Tuple[str, str], int] = {m: r for r, m in enumerate(merges)}
+        self.special_tokens = dict(special_tokens or {})
+        self.id_to_special = {i: t for t, i in self.special_tokens.items()}
+        self._pattern = re.compile(pattern)
+        self._special_re = (
+            re.compile("|".join(re.escape(t) for t in sorted(self.special_tokens, key=len, reverse=True)))
+            if self.special_tokens
+            else None
+        )
+        self._b2u = byte_to_unicode()
+        self._u2b = unicode_to_byte()
+        self._cache: Dict[str, List[int]] = {}
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab) + len(
+            [t for t in self.special_tokens if t not in self.vocab]
+        )
+
+    # ------------------------------------------------------------------
+    def _bpe_word(self, word: str) -> List[int]:
+        """Merge loop over one pre-token (already byte-remapped)."""
+        cached = self._cache.get(word)
+        if cached is not None:
+            return cached
+        parts = list(word)
+        while len(parts) > 1:
+            best_rank = None
+            best_i = -1
+            for i in range(len(parts) - 1):
+                r = self.ranks.get((parts[i], parts[i + 1]))
+                if r is not None and (best_rank is None or r < best_rank):
+                    best_rank, best_i = r, i
+            if best_rank is None:
+                break
+            parts[best_i : best_i + 2] = [parts[best_i] + parts[best_i + 1]]
+        ids = []
+        for p in parts:
+            tid = self.vocab.get(p)
+            if tid is None:
+                # unmergeable unknown: emit per-char byte tokens where known
+                ids.extend(self.vocab[c] for c in p if c in self.vocab)
+            else:
+                ids.append(tid)
+        if len(self._cache) < 65536:
+            self._cache[word] = ids
+        return ids
+
+    def _encode_ordinary(self, text: str) -> List[int]:
+        ids: List[int] = []
+        for m in self._pattern.finditer(text):
+            piece = m.group(0)
+            remapped = "".join(self._b2u[b] for b in piece.encode("utf-8"))
+            ids.extend(self._bpe_word(remapped))
+        return ids
+
+    def encode(self, text: str, add_bos: bool = False, bos_id: Optional[int] = None) -> List[int]:
+        """Encode, honoring special tokens embedded in the text (chat headers)."""
+        ids: List[int] = []
+        if add_bos and bos_id is not None:
+            ids.append(bos_id)
+        if self._special_re is None:
+            ids.extend(self._encode_ordinary(text))
+            return ids
+        pos = 0
+        for m in self._special_re.finditer(text):
+            if m.start() > pos:
+                ids.extend(self._encode_ordinary(text[pos : m.start()]))
+            ids.append(self.special_tokens[m.group(0)])
+            pos = m.end()
+        if pos < len(text):
+            ids.extend(self._encode_ordinary(text[pos:]))
+        return ids
+
+    def decode(self, ids: Iterable[int], skip_special_tokens: bool = True) -> str:
+        out: List[str] = []
+        buf: List[int] = []
+
+        def flush():
+            if buf:
+                out.append(bytes(buf).decode("utf-8", errors="replace"))
+                buf.clear()
+
+        for i in ids:
+            sp = self.id_to_special.get(int(i))
+            if sp is not None:
+                flush()
+                if not skip_special_tokens:
+                    out.append(sp)
+                continue
+            tok = self.id_to_token.get(int(i))
+            if tok is None:
+                continue
+            buf.extend(self._u2b[c] for c in tok if c in self._u2b)
+        flush()
+        return "".join(out)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_tokenizer_json(cls, path: str) -> "ByteLevelBPETokenizer":
+        with open(path, encoding="utf-8") as f:
+            spec = json.load(f)
+        model = spec["model"]
+        if model.get("type") != "BPE":
+            raise ValueError(f"not a BPE tokenizer.json: {model.get('type')}")
+        vocab = dict(model["vocab"])
+        merges = []
+        for m in model["merges"]:
+            if isinstance(m, str):
+                a, b = m.split(" ", 1)
+            else:
+                a, b = m
+            merges.append((a, b))
+        specials = {
+            t["content"]: t["id"] for t in spec.get("added_tokens", []) if t.get("special")
+        }
+        return cls(
+            vocab=vocab,
+            merges=merges,
+            special_tokens=specials,
+            pattern=_pattern_from_spec(spec),
+        )

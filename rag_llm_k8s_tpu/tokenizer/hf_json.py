@@ -1,0 +1,24 @@
+"""Dispatch loader for HF ``tokenizer.json`` files."""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def load_tokenizer(path: str):
+    """Load a tokenizer from a ``tokenizer.json`` file or a directory holding
+    one. Returns :class:`ByteLevelBPETokenizer` or :class:`UnigramTokenizer`
+    depending on the model type."""
+    from rag_llm_k8s_tpu.tokenizer.bpe import ByteLevelBPETokenizer
+    from rag_llm_k8s_tpu.tokenizer.unigram import UnigramTokenizer
+
+    if os.path.isdir(path):
+        path = os.path.join(path, "tokenizer.json")
+    with open(path, encoding="utf-8") as f:
+        kind = json.load(f)["model"]["type"]
+    if kind == "BPE":
+        return ByteLevelBPETokenizer.from_tokenizer_json(path)
+    if kind == "Unigram":
+        return UnigramTokenizer.from_tokenizer_json(path)
+    raise ValueError(f"unsupported tokenizer model type: {kind}")

@@ -1,0 +1,142 @@
+"""Unigram (SentencePiece) tokenizer — the XLM-R / bge-m3 algorithm.
+
+Loads the HF ``tokenizer.json`` of a Unigram model and segments with Viterbi
+over piece log-probabilities (max-likelihood segmentation), with the
+Metaspace pre-tokenizer (word-initial ``▁``). Replaces the Rust tokenizer
+behind the reference's ``SentenceTransformer('BAAI/bge-m3')``
+(/root/reference/llm/rag.py:33).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional, Tuple
+
+_SPACE = "▁"  # ▁
+
+
+class _Trie:
+    __slots__ = ("children", "piece_id", "score")
+
+    def __init__(self):
+        self.children: Dict[str, "_Trie"] = {}
+        self.piece_id: Optional[int] = None
+        self.score: float = 0.0
+
+
+class UnigramTokenizer:
+    def __init__(
+        self,
+        pieces: List[Tuple[str, float]],
+        unk_id: Optional[int] = None,
+        special_tokens: Optional[Dict[str, int]] = None,
+        bos_id: Optional[int] = 0,
+        eos_id: Optional[int] = 2,
+        add_bos_eos: bool = True,
+    ):
+        self.pieces = pieces
+        self.unk_id = unk_id
+        self.special_tokens = dict(special_tokens or {})
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+        self.add_bos_eos = add_bos_eos
+        self.id_to_piece = {i: p for i, (p, _) in enumerate(pieces)}
+        for t, i in self.special_tokens.items():
+            self.id_to_piece.setdefault(i, t)
+        self._root = _Trie()
+        for i, (piece, score) in enumerate(pieces):
+            node = self._root
+            for ch in piece:
+                node = node.children.setdefault(ch, _Trie())
+            node.piece_id = i
+            node.score = score
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.pieces)
+
+    # ------------------------------------------------------------------
+    def _viterbi(self, text: str) -> List[int]:
+        n = len(text)
+        NEG = -1e18
+        best = [NEG] * (n + 1)
+        back: List[Tuple[int, Optional[int]]] = [(-1, None)] * (n + 1)
+        best[0] = 0.0
+        unk_penalty = -20.0
+        for i in range(n):
+            if best[i] == NEG:
+                continue
+            node = self._root
+            j = i
+            matched = False
+            while j < n:
+                node = node.children.get(text[j])
+                if node is None:
+                    break
+                j += 1
+                if node.piece_id is not None:
+                    matched = True
+                    s = best[i] + node.score
+                    if s > best[j]:
+                        best[j] = s
+                        back[j] = (i, node.piece_id)
+            if not matched or best[i + 1] == NEG:
+                # unk fallback: single char
+                s = best[i] + unk_penalty
+                if s > best[i + 1]:
+                    best[i + 1] = s
+                    back[i + 1] = (i, self.unk_id)
+        ids: List[int] = []
+        pos = n
+        while pos > 0:
+            prev, pid = back[pos]
+            if pid is not None:
+                ids.append(pid)
+            pos = prev
+        ids.reverse()
+        return ids
+
+    def encode(self, text: str, add_special: Optional[bool] = None) -> List[int]:
+        add_special = self.add_bos_eos if add_special is None else add_special
+        # Metaspace: prepend ▁, spaces → ▁ (sentencepiece whitespace handling)
+        body = _SPACE + text.strip().replace(" ", _SPACE)
+        ids = self._viterbi(body)
+        if add_special and self.bos_id is not None and self.eos_id is not None:
+            return [self.bos_id] + ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids: Iterable[int], skip_special_tokens: bool = True) -> str:
+        specials = set(self.special_tokens.values())
+        if self.bos_id is not None:
+            specials.add(self.bos_id)
+        if self.eos_id is not None:
+            specials.add(self.eos_id)
+        parts: List[str] = []
+        for i in ids:
+            i = int(i)
+            if skip_special_tokens and i in specials:
+                continue
+            parts.append(self.id_to_piece.get(i, ""))
+        return "".join(parts).replace(_SPACE, " ").strip()
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_tokenizer_json(cls, path: str) -> "UnigramTokenizer":
+        with open(path, encoding="utf-8") as f:
+            spec = json.load(f)
+        model = spec["model"]
+        if model.get("type") != "Unigram":
+            raise ValueError(f"not a Unigram tokenizer.json: {model.get('type')}")
+        pieces = [(p, float(s)) for p, s in model["vocab"]]
+        specials = {
+            t["content"]: t["id"] for t in spec.get("added_tokens", []) if t.get("special")
+        }
+        bos = specials.get("<s>")
+        eos = specials.get("</s>")
+        return cls(
+            pieces=pieces,
+            unk_id=model.get("unk_id"),
+            special_tokens=specials,
+            bos_id=bos,
+            eos_id=eos,
+        )

@@ -1,0 +1,5 @@
+"""Shared utilities."""
+
+from rag_llm_k8s_tpu.utils.buckets import bucket_len, next_pow2
+
+__all__ = ["bucket_len", "next_pow2"]

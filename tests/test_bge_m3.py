@@ -1,0 +1,85 @@
+"""bge-m3 encoder tests: CLS-pooled unit vectors + parity vs HF XLM-R torch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rag_llm_k8s_tpu.core.config import DTypePolicy, EncoderConfig
+from rag_llm_k8s_tpu.models.bge_m3 import BgeM3Encoder, init_encoder_params, xlmr_position_ids
+from rag_llm_k8s_tpu.models.loader import convert_xlmr_state_dict
+
+FP32 = DTypePolicy.fp32()
+
+
+class TestEncoder:
+    def test_output_is_unit_norm(self):
+        cfg = EncoderConfig.tiny()
+        params = init_encoder_params(jax.random.PRNGKey(0), cfg, FP32)
+        model = BgeM3Encoder(cfg, FP32)
+        tokens = jnp.array([[0, 5, 6, 7, 2, 1, 1, 1]], jnp.int32)  # right-padded
+        mask = (tokens != cfg.pad_token_id).astype(jnp.int32)
+        out = model.apply({"params": params}, tokens, mask)
+        assert out.shape == (1, cfg.hidden_size)
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(out), axis=-1), 1.0, rtol=1e-5)
+
+    def test_padding_invariance(self):
+        """Extra right-padding must not change the embedding."""
+        cfg = EncoderConfig.tiny()
+        params = init_encoder_params(jax.random.PRNGKey(0), cfg, FP32)
+        model = BgeM3Encoder(cfg, FP32)
+        t1 = jnp.array([[0, 5, 6, 2]], jnp.int32)
+        t2 = jnp.array([[0, 5, 6, 2, 1, 1, 1, 1]], jnp.int32)
+        m1 = (t1 != 1).astype(jnp.int32)
+        m2 = (t2 != 1).astype(jnp.int32)
+        e1 = model.apply({"params": params}, t1, m1)
+        e2 = model.apply({"params": params}, t2, m2)
+        np.testing.assert_allclose(np.asarray(e1), np.asarray(e2), rtol=1e-4, atol=1e-5)
+
+    def test_position_ids(self):
+        tokens = jnp.array([[0, 5, 6, 1, 1]], jnp.int32)
+        pos = xlmr_position_ids(tokens, pad_id=1)
+        assert pos.tolist() == [[2, 3, 4, 1, 1]]
+
+
+class TestXlmrParity:
+    def test_tiny_parity_vs_hf(self):
+        torch = pytest.importorskip("torch")
+        from transformers import XLMRobertaConfig, XLMRobertaModel
+
+        cfg = EncoderConfig.tiny(vocab_size=100)
+        hf_cfg = XLMRobertaConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            num_hidden_layers=cfg.num_layers,
+            num_attention_heads=cfg.num_heads,
+            intermediate_size=cfg.intermediate_size,
+            max_position_embeddings=cfg.max_position_embeddings,
+            type_vocab_size=cfg.type_vocab_size,
+            layer_norm_eps=cfg.layer_norm_eps,
+            pad_token_id=cfg.pad_token_id,
+            hidden_act="gelu",
+        )
+        torch.manual_seed(0)
+        hf = XLMRobertaModel(hf_cfg, add_pooling_layer=False).eval()
+
+        params = convert_xlmr_state_dict(dict(hf.state_dict()), cfg, FP32)
+        model = BgeM3Encoder(cfg, FP32)
+
+        tokens_np = np.array(
+            [[0, 10, 11, 12, 13, 2, 1, 1], [0, 20, 21, 2, 1, 1, 1, 1]], np.int64
+        )
+        mask_np = (tokens_np != cfg.pad_token_id).astype(np.int64)
+        with torch.no_grad():
+            hf_out = hf(
+                input_ids=torch.tensor(tokens_np), attention_mask=torch.tensor(mask_np)
+            ).last_hidden_state.numpy()
+        hf_cls = hf_out[:, 0, :]
+        hf_embed = hf_cls / np.linalg.norm(hf_cls, axis=-1, keepdims=True)
+
+        ours = model.apply(
+            {"params": params},
+            jnp.asarray(tokens_np, jnp.int32),
+            jnp.asarray(mask_np, jnp.int32),
+        )
+        np.testing.assert_allclose(np.asarray(ours), hf_embed, rtol=1e-3, atol=1e-4)

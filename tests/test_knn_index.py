@@ -1,0 +1,165 @@
+"""kNN kernel numerics (interpret mode vs oracles) + vector store semantics."""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rag_llm_k8s_tpu.index.store import VectorStore
+from rag_llm_k8s_tpu.ops.knn import BIG, knn_topk_pallas, knn_topk_xla
+
+
+def _random_problem(seed, N=1024, D=64, Q=4, n_valid=None):
+    rng = np.random.RandomState(seed)
+    e = rng.randn(N, D).astype(np.float32)
+    q = rng.randn(Q, D).astype(np.float32)
+    n_valid = N if n_valid is None else n_valid
+    norms = (e**2).sum(1)
+    norms[n_valid:] = BIG
+    return q, e, norms, n_valid
+
+
+class TestKnnKernel:
+    @pytest.mark.parametrize("n_valid", [1024, 1000, 700])
+    def test_pallas_matches_numpy_oracle(self, n_valid):
+        q, e, norms, nv = _random_problem(0, n_valid=n_valid)
+        pv, pi = knn_topk_pallas(
+            jnp.asarray(q), jnp.asarray(e), jnp.asarray(norms)[None, :],
+            k=5, block_n=256, interpret=True,
+        )
+        d = ((q[:, None, :] - e[None, :nv, :]) ** 2).sum(-1)
+        oracle_idx = np.argsort(d, axis=1)[:, :5]
+        np.testing.assert_array_equal(np.asarray(pi), oracle_idx)
+        np.testing.assert_allclose(
+            np.asarray(pv), np.take_along_axis(d, oracle_idx, 1), rtol=1e-4, atol=1e-3
+        )
+
+    def test_xla_fallback_matches_oracle(self):
+        q, e, norms, nv = _random_problem(1)
+        ev, ei = knn_topk_xla(jnp.asarray(q), jnp.asarray(e), jnp.asarray(norms)[None, :], k=5)
+        d = ((q[:, None, :] - e[None, :, :]) ** 2).sum(-1)
+        oracle_idx = np.argsort(d, axis=1)[:, :5]
+        np.testing.assert_array_equal(np.asarray(ei), oracle_idx)
+
+    def test_single_query_single_block(self):
+        q, e, norms, _ = _random_problem(2, N=256, Q=1)
+        pv, pi = knn_topk_pallas(
+            jnp.asarray(q), jnp.asarray(e), jnp.asarray(norms)[None, :],
+            k=5, block_n=256, interpret=True,
+        )
+        ev, ei = knn_topk_xla(jnp.asarray(q), jnp.asarray(e), jnp.asarray(norms)[None, :], k=5)
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(ei))
+
+
+class TestVectorStore:
+    def _mk(self, n=10, dim=8, path=None, seed=0):
+        rng = np.random.RandomState(seed)
+        store = VectorStore(dim=dim, path=path)
+        vecs = rng.randn(n, dim).astype(np.float32)
+        meta = [{"filename": "a.pdf", "chunk_id": i, "text": f"chunk {i}"} for i in range(n)]
+        assert store.add(vecs, meta) == n
+        return store, vecs, meta
+
+    def test_search_returns_nearest(self):
+        store, vecs, meta = self._mk()
+        res = store.search(vecs[3], k=3)
+        assert res[0].metadata["chunk_id"] == 3
+        assert res[0].distance == pytest.approx(0.0, abs=1e-4)
+        assert len(res) == 3
+
+    def test_search_k_clamped_to_size(self):
+        store, vecs, _ = self._mk(n=2)
+        assert len(store.search(vecs[0], k=5)) == 2
+
+    def test_empty_store_search(self):
+        store = VectorStore(dim=8)
+        assert store.search(np.zeros(8)) == []
+
+    def test_dedup_idempotent_reingest(self):
+        """The reference duplicates every chunk on pod restart (survey §3.1);
+        re-adding identical content must be a no-op here."""
+        store, vecs, meta = self._mk()
+        assert store.add(vecs, meta) == 0
+        assert store.ntotal == 10
+
+    def test_dim_mismatch_rejected(self):
+        store = VectorStore(dim=8)
+        with pytest.raises(ValueError, match="dim"):
+            store.add([np.zeros(4, np.float32)], [{"text": "x"}])
+
+    def test_save_load_roundtrip(self, tmp_path):
+        p = str(tmp_path / "idx")
+        store, vecs, meta = self._mk(path=p)
+        store.save()
+        loaded = VectorStore.load(p)
+        assert loaded.ntotal == 10
+        assert loaded.generation == store.generation
+        r1 = store.search(vecs[5], k=2)
+        r2 = loaded.search(vecs[5], k=2)
+        assert [x.metadata for x in r1] == [y.metadata for y in r2]
+        # dedup state survives persistence
+        assert loaded.add(vecs, meta) == 0
+
+    def test_open_or_create(self, tmp_path):
+        p = str(tmp_path / "idx")
+        s = VectorStore.open_or_create(p, dim=8)
+        assert s.ntotal == 0
+        s.add([np.ones(8, np.float32)], [{"text": "t"}])
+        s.save()
+        s2 = VectorStore.open_or_create(p, dim=8)
+        assert s2.ntotal == 1
+
+    def test_corrupt_metadata_rejected(self, tmp_path):
+        p = str(tmp_path / "idx")
+        store, _, _ = self._mk(path=p)
+        store.save()
+        with open(p) as f:
+            meta = json.load(f)
+        meta["count"] = 99
+        with open(p, "w") as f:
+            json.dump(meta, f)
+        with pytest.raises(ValueError, match="corrupt"):
+            VectorStore.load(p)
+
+    def test_info_shape(self):
+        store, _, _ = self._mk()
+        info = store.info()
+        assert info["total_vectors"] == 10
+        assert info["dimension"] == 8
+        assert len(info["sample_chunks"]) == 5
+
+    def test_concurrent_adds_no_loss(self):
+        """The race the reference has at rag.py:68-86: concurrent ingest must
+        not lose vectors."""
+        store = VectorStore(dim=8)
+        rng = np.random.RandomState(7)
+        batches = [
+            (
+                rng.randn(5, 8).astype(np.float32),
+                [{"filename": f"f{t}.pdf", "chunk_id": i, "text": f"{t}-{i}"} for i in range(5)],
+            )
+            for t in range(8)
+        ]
+        threads = [
+            threading.Thread(target=lambda b=b: store.add(b[0], b[1])) for b in batches
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert store.ntotal == 40
+
+    def test_grow_across_pad_bucket(self):
+        """Index growth past the padding bucket keeps search correct."""
+        rng = np.random.RandomState(3)
+        store = VectorStore(dim=8)
+        v1 = rng.randn(500, 8).astype(np.float32)
+        store.add(v1, [{"text": f"a{i}"} for i in range(500)])
+        _ = store.search(v1[0], k=1)  # builds 512-pad snapshot
+        v2 = rng.randn(50, 8).astype(np.float32)
+        store.add(v2, [{"text": f"b{i}"} for i in range(50)])
+        res = store.search(v2[10], k=1)  # needs 1024-pad snapshot
+        assert res[0].metadata["text"] == "b10"

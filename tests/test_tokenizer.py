@@ -1,0 +1,114 @@
+"""Tokenizer parity tests against the HF Rust ``tokenizers`` library (the
+exact engine the reference uses via AutoTokenizer, rag.py:25): train a small
+byte-level BPE / Unigram model, save tokenizer.json, reload with the
+framework's pure-Python implementations, and compare token ids exactly."""
+
+import pytest
+
+tokenizers = pytest.importorskip("tokenizers")
+
+from tokenizers import Tokenizer  # noqa: E402
+from tokenizers.models import BPE, Unigram  # noqa: E402
+from tokenizers.pre_tokenizers import ByteLevel, Metaspace  # noqa: E402
+from tokenizers.decoders import ByteLevel as ByteLevelDecoder  # noqa: E402
+from tokenizers.trainers import BpeTrainer, UnigramTrainer  # noqa: E402
+
+from rag_llm_k8s_tpu.tokenizer import load_tokenizer  # noqa: E402
+
+CORPUS = [
+    "The Technology Radar is a snapshot of tools, techniques, platforms and languages.",
+    "Retrieval-augmented generation improves factuality of large language models.",
+    "TPU v5e slices communicate over ICI links; XLA emits the collectives.",
+    "def split_text(text, chunk_size=1000, overlap=200):",
+    "Hello world! 12345 -- naive tokenization tests, with punctuation...",
+    "Multilingual text: cafe, uber, naive.",
+] * 8
+
+SAMPLES = [
+    "The Technology Radar improves tools and platforms.",
+    "hello hello world 123",
+    "def f(x): return x+1",
+    "punctuation!!! and... spaces   here",
+    "",
+    "a",
+]
+
+
+class TestBPEParity:
+    @pytest.fixture(scope="class")
+    def pair(self, tmp_path_factory):
+        tok = Tokenizer(BPE(unk_token=None))
+        tok.pre_tokenizer = ByteLevel(add_prefix_space=False, use_regex=True)
+        tok.decoder = ByteLevelDecoder()
+        trainer = BpeTrainer(
+            vocab_size=400,
+            special_tokens=["<|begin_of_text|>", "<|end_of_text|>"],
+            initial_alphabet=ByteLevel.alphabet(),
+            show_progress=False,
+        )
+        tok.train_from_iterator(CORPUS, trainer)
+        p = tmp_path_factory.mktemp("bpe") / "tokenizer.json"
+        tok.save(str(p))
+        return tok, load_tokenizer(str(p))
+
+    @pytest.mark.parametrize("text", SAMPLES)
+    def test_encode_matches_rust(self, pair, text):
+        rust, ours = pair
+        assert ours.encode(text) == rust.encode(text).ids
+
+    @pytest.mark.parametrize("text", SAMPLES)
+    def test_decode_roundtrip(self, pair, text):
+        rust, ours = pair
+        ids = ours.encode(text)
+        assert ours.decode(ids) == rust.decode(ids)
+
+    def test_special_tokens_split(self, pair):
+        rust, ours = pair
+        text = "<|begin_of_text|>hello world<|end_of_text|>"
+        got = ours.encode(text)
+        assert got[0] == ours.special_tokens["<|begin_of_text|>"]
+        assert got[-1] == ours.special_tokens["<|end_of_text|>"]
+        # interior matches rust's encoding of the plain text
+        assert got[1:-1] == rust.encode("hello world").ids
+
+
+class TestUnigramParity:
+    @pytest.fixture(scope="class")
+    def pair(self, tmp_path_factory):
+        tok = Tokenizer(Unigram())
+        tok.pre_tokenizer = Metaspace()
+        trainer = UnigramTrainer(
+            vocab_size=300,
+            special_tokens=["<s>", "</s>", "<unk>"],
+            unk_token="<unk>",
+            show_progress=False,
+        )
+        tok.train_from_iterator(CORPUS, trainer)
+        p = tmp_path_factory.mktemp("uni") / "tokenizer.json"
+        tok.save(str(p))
+        return tok, load_tokenizer(str(p))
+
+    @pytest.mark.parametrize("text", [s for s in SAMPLES if s])
+    def test_encode_matches_rust(self, pair, text):
+        rust, ours = pair
+        assert ours.encode(text, add_special=False) == rust.encode(text).ids
+
+    @pytest.mark.parametrize(
+        "text",
+        [
+            "The Technology Radar improves tools and platforms.",
+            "hello world 123",
+            "naive tokenization tests, with punctuation...",
+        ],
+    )
+    def test_decode_roundtrip_covered_text(self, pair, text):
+        """For in-vocabulary text, decode(encode(x)) == x (modulo whitespace
+        normalization). OOV chars map to <unk> and are lossy by design."""
+        _, ours = pair
+        ids = ours.encode(text, add_special=False)
+        assert ours.decode(ids).split() == text.split()
+
+    def test_oov_degrades_to_unk(self, pair):
+        _, ours = pair
+        ids = ours.encode("x+1", add_special=False)
+        assert ours.unk_id in ids  # '+' is not in the trained vocab
