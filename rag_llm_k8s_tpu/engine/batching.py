@@ -1,0 +1,109 @@
+"""Continuous request batching for the serving engine.
+
+The reference serves strictly sequentially: a single-threaded Flask dev server
+runs one ``model.generate`` at a time (/root/reference/llm/rag.py:204) — a
+second concurrent user waits for the whole first generation. Here concurrent
+requests coalesce into batched decodes (BASELINE.json config #5: "batched
+concurrent /query requests"): a dispatcher thread drains the queue, groups
+waiting requests up to the engine's batch cap, and runs them as ONE device
+program — decode cost is dominated by weight reads from HBM, so a batch of 8
+costs barely more than a batch of 1.
+
+Requests submit from any thread and block on their own event; results fan
+back out in submission order. Grouping respects ``max_new_tokens``/seed so
+every request in a batch shares one executable.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+
+
+@dataclass
+class _Pending:
+    prompt: List[int]
+    max_new: Optional[int]
+    seed: Optional[int]
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[List[int]] = None
+    error: Optional[BaseException] = None
+
+
+class BatchScheduler:
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        max_wait_ms: float = 5.0,
+    ):
+        self.engine = engine
+        self.max_wait_ms = max_wait_ms
+        self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True, name="batch-scheduler")
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    def submit(
+        self,
+        prompt: List[int],
+        max_new_tokens: Optional[int] = None,
+        seed: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> List[int]:
+        """Blocking: enqueue and wait for this prompt's continuation."""
+        if self._stop.is_set():
+            raise RuntimeError("scheduler is shut down")
+        item = _Pending(prompt=list(prompt), max_new=max_new_tokens, seed=seed)
+        self._queue.put(item)
+        if not item.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def shutdown(self):
+        self._stop.set()
+        self._queue.put(None)  # wake the worker
+        self._worker.join(timeout=5)
+
+    # ------------------------------------------------------------------
+    def _run(self):
+        while not self._stop.is_set():
+            first = self._queue.get()
+            if first is None:
+                continue
+            batch = [first]
+            cap = self.engine.engine_config.max_batch_size
+            # drain compatible requests within the coalescing window
+            deadline = self.max_wait_ms / 1e3
+            while len(batch) < cap:
+                try:
+                    nxt = self._queue.get(timeout=deadline)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    break
+                if nxt.max_new == first.max_new and nxt.seed == first.seed:
+                    batch.append(nxt)
+                else:
+                    self._queue.put(nxt)  # different executable: next round
+                    break
+            try:
+                outs = self.engine.generate(
+                    [b.prompt for b in batch],
+                    max_new_tokens=first.max_new,
+                    seed=first.seed,
+                )
+                for b, out in zip(batch, outs):
+                    b.result = out
+            except BaseException as e:  # noqa: BLE001 — deliver to all waiters
+                for b in batch:
+                    b.error = e
+            finally:
+                for b in batch:
+                    b.done.set()

@@ -59,10 +59,9 @@ def _content_hash(metadata: Dict) -> str:
 
 
 def _pad_bucket(n: int, minimum: int = 512) -> int:
-    b = minimum
-    while b < n:
-        b *= 2
-    return b
+    from rag_llm_k8s_tpu.utils.buckets import next_pow2
+
+    return max(minimum, next_pow2(n))
 
 
 class VectorStore:

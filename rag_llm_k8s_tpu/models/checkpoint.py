@@ -1,0 +1,76 @@
+"""Sharded-native parameter checkpoints (orbax).
+
+The reference's only weight persistence is the raw HF safetensors layout on
+the PVC (staged once — survey §5 "checkpoint/resume: persistence-only").
+Converting that layout to the framework's stacked/sharded form costs a full
+transpose+stack pass over 8B params at every boot. This module caches the
+CONVERTED form as an orbax checkpoint next to the staged weights: subsequent
+boots restore each shard straight to its device placement (orbax reads are
+parallel and sharding-aware), cutting restart time — part of the fast-restart
+story (survey §5 failure-detection note).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+CACHE_SUBDIR = "tpu_rag_param_cache"
+
+
+def save_params(path: str, params) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, params, force=True)
+    logger.info("saved sharded param cache at %s", path)
+
+
+def restore_params(path: str, abstract_params):
+    """Restore with target shardings taken from ``abstract_params`` (a tree of
+    jax.ShapeDtypeStruct with ``sharding`` set, or real arrays)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    template = jax.tree.map(
+        lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=getattr(leaf, "sharding", None))
+        if not isinstance(leaf, jax.ShapeDtypeStruct)
+        else leaf,
+        abstract_params,
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path, template)
+
+
+def load_params_cached(
+    model_dir: str,
+    convert: Callable[[], object],
+    abstract_params_fn: Optional[Callable[[], object]] = None,
+    cache_dir: Optional[str] = None,
+):
+    """Restore the converted+sharded params from cache, or convert from the
+    staged safetensors (``convert``) and populate the cache.
+
+    ``abstract_params_fn`` supplies the target tree (shapes/dtypes/shardings)
+    for restore; without it, cache restore is skipped on first use.
+    """
+    cache = cache_dir or os.path.join(model_dir, CACHE_SUBDIR)
+    if os.path.isdir(cache) and abstract_params_fn is not None:
+        try:
+            params = restore_params(cache, abstract_params_fn())
+            logger.info("restored params from sharded cache %s", cache)
+            return params
+        except Exception:  # noqa: BLE001 — stale/corrupt cache falls back to convert
+            logger.exception("param cache restore failed; reconverting")
+    params = convert()
+    try:
+        save_params(cache, params)
+    except Exception:  # noqa: BLE001 — caching is best-effort
+        logger.exception("param cache save failed (continuing without cache)")
+    return params

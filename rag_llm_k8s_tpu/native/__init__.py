@@ -1,0 +1,6 @@
+"""Native (C++) components, built on demand with the system toolchain and
+loaded via ctypes. See ``bpe.cpp`` (tokenizer merge loop)."""
+
+from rag_llm_k8s_tpu.native.build import load_library
+
+__all__ = ["load_library"]

@@ -1,0 +1,45 @@
+"""On-demand compilation + ctypes loading of the C++ components.
+
+No pybind11 in this environment (and no Rust), so native code exposes a plain
+C ABI compiled with the system g++ and is driven through ctypes. Libraries
+build once into the package directory and are cached by source mtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+def load_library(name: str) -> Optional[ctypes.CDLL]:
+    """Build (if stale) and load ``lib<name>.so`` from ``<name>.cpp``.
+
+    Returns None when no C++ toolchain is available — callers fall back to
+    their pure-Python implementations.
+    """
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        src = os.path.join(_DIR, f"{name}.cpp")
+        lib_path = os.path.join(_DIR, f"lib{name}.so")
+        try:
+            if not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(src):
+                cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", lib_path, src]
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+                logger.info("built native library %s", lib_path)
+            lib = ctypes.CDLL(lib_path)
+        except (OSError, subprocess.SubprocessError) as e:
+            logger.warning("native %s unavailable (%s); using pure-Python path", name, e)
+            lib = None
+        _CACHE[name] = lib
+        return lib

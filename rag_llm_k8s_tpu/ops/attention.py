@@ -1,0 +1,199 @@
+"""Flash attention (prefill) as a Pallas TPU kernel.
+
+The torch path this replaces materializes full [S, S] attention matrices on
+CPU inside ``model.generate`` (/root/reference/llm/rag.py:172). Here the
+prefill attention runs blockwise: per (head, q-block), K/V blocks stream
+through VMEM while a running (max, sum, accumulator) softmax keeps memory at
+O(block²) — the flash-attention recurrence, written for the MXU/VPU split
+(matmuls on the MXU via ``jax.lax.dot_general`` with fp32 accumulation,
+renormalization on the VPU).
+
+Masking model matches the serving engine's left-padded batches: causal over
+global positions plus a per-row valid window ``[kv_start, kv_len)`` delivered
+through scalar prefetch (SMEM) — no [S, S] bias array ever exists.
+
+GQA is handled by index mapping: query head h reads K/V head ``h // G``
+directly from HBM; K/V are never repeated in memory.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    kv_start_ref,  # SMEM [B]
+    kv_len_ref,  # SMEM [B]
+    q_ref,  # [1, bq, hd]
+    k_ref,  # [1, bk, hd]
+    v_ref,  # [1, bk, hd]
+    o_ref,  # [1, bq, hd]
+    m_scr,  # VMEM [bq, 1]
+    l_scr,  # VMEM [bq, 1]
+    acc_scr,  # VMEM [bq, hd]
+    *,
+    bq: int,
+    bk: int,
+    scale: float,
+    causal: bool,
+    num_heads: int,
+):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+    b = bh // num_heads
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal skip: a K block strictly above this Q block's diagonal is fully
+    # masked — skip its matmuls entirely (halves causal prefill work)
+    live = (kj * bk <= qi * bq + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+
+        q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        ok = (k_pos >= kv_start_ref[b]) & (k_pos < kv_len_ref[b])
+        if causal:
+            ok = ok & (k_pos <= q_pos)
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_scr[:]  # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # explicit zero for masked entries: when a whole row is masked both s
+        # and m_new sit at NEG_INF and exp(s - m_new) would be 1, polluting
+        # l/acc with mean(V); the mask multiply makes such rows emit zeros
+        p = jnp.where(ok, jnp.exp(s - m_new), 0.0)  # [bq, bk]
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:], 1e-30)  # fully-masked rows -> 0, not NaN
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "bq", "bk", "interpret")
+)
+def flash_attention(
+    q: jax.Array,  # [B, Sq, H, hd]
+    k: jax.Array,  # [B, Sk, K, hd]
+    v: jax.Array,  # [B, Sk, K, hd]
+    kv_start: Optional[jax.Array] = None,  # [B] int32 (left-pad offset)
+    kv_len: Optional[jax.Array] = None,  # [B] int32 (valid frontier)
+    causal: bool = True,
+    bq: int = 128,
+    bk: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Blockwise fused attention; returns ``[B, Sq, H, hd]`` in q's dtype."""
+    B, Sq, H, hd = q.shape
+    _, Sk, K, _ = k.shape
+    G = H // K
+    bq = min(bq, Sq)
+    bk = min(bk, Sk)
+    assert Sq % bq == 0 and Sk % bk == 0, (Sq, bq, Sk, bk)
+    if kv_start is None:
+        kv_start = jnp.zeros((B,), jnp.int32)
+    if kv_len is None:
+        kv_len = jnp.full((B,), Sk, jnp.int32)
+
+    # [B, S, H, hd] -> [B*H, S, hd] rows; kv head for query head h is h // G
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, Sq, hd)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * K, Sk, hd)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * K, Sk, hd)
+
+    grid = (B * H, Sq // bq, Sk // bk)
+
+    def kv_index(bh, qi, kj, *scalar_refs):
+        return ((bh // H) * K + (bh % H) // G, kj, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            bq=bq,
+            bk=bk,
+            scale=hd**-0.5,
+            causal=causal,
+            num_heads=H,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, hd), lambda bh, qi, kj, *s_: (bh, qi, 0)),
+                pl.BlockSpec((1, bk, hd), kv_index),
+                pl.BlockSpec((1, bk, hd), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, bq, hd), lambda bh, qi, kj, *s_: (bh, qi, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, hd), q.dtype),
+        interpret=interpret,
+    )(kv_start.astype(jnp.int32), kv_len.astype(jnp.int32), qt, kt, vt)
+
+    return out.reshape(B, H, Sq, hd).transpose(0, 2, 1, 3)
+
+
+def attention_xla(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_start: Optional[jax.Array] = None,
+    kv_len: Optional[jax.Array] = None,
+    causal: bool = True,
+) -> jax.Array:
+    """Dense XLA reference (oracle for the kernel; fallback off-TPU)."""
+    B, Sq, H, hd = q.shape
+    _, Sk, K, _ = k.shape
+    G = H // K
+    qg = q.reshape(B, Sq, K, G, hd)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k, preferred_element_type=jnp.float32)
+    s = s * (hd**-0.5)
+    q_pos = jnp.arange(Sq)
+    k_pos = jnp.arange(Sk)
+    ok = jnp.ones((B, Sq, Sk), bool)
+    if kv_start is not None:
+        ok = ok & (k_pos[None, None, :] >= kv_start[:, None, None])
+    if kv_len is not None:
+        ok = ok & (k_pos[None, None, :] < kv_len[:, None, None])
+    if causal:
+        ok = ok & (k_pos[None, None, :] <= q_pos[None, :, None])
+    s = jnp.where(ok[:, None, None, :, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # rows with no valid key: softmax of all-NEG_INF is uniform — zero it so
+    # pad rows contribute nothing downstream (matches the fused kernels)
+    p = jnp.where(ok[:, None, None, :, :], p, 0.0)
+    o = jnp.einsum("bkgqs,bskd->bqkgd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o.reshape(B, Sq, H, hd).astype(q.dtype)

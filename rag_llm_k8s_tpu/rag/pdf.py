@@ -150,8 +150,7 @@ def _parse_literal_string(data: bytes, pos: int):
             if e in mapping:
                 out += mapping[e]
                 pos += 1
-            elif e.isdigit():  # octal, up to 3 digits
-                m = re.match(rb"[0-7]{1,3}", data[pos:])
+            elif (m := re.match(rb"[0-7]{1,3}", data[pos:])) is not None:  # octal
                 out.append(int(m.group(0), 8) & 0xFF)
                 pos += len(m.group(0))
             elif e in (b"\n", b"\r"):  # line continuation
@@ -464,17 +463,55 @@ def _extract_page_text(content: bytes, fonts: Dict[str, FontDecoder]) -> str:
 # ---------------------------------------------------------------------------
 
 
+def _pages_in_reading_order(doc: PdfDocument) -> List[dict]:
+    """Walk the /Pages tree from the catalog (the spec's reading order, what
+    PyPDF2's ``reader.pages`` yields); fall back to object-number order only
+    if no catalog tree is parseable."""
+    catalog = next(
+        (
+            obj
+            for _, obj in sorted(doc.objects.items())
+            if isinstance(obj, dict) and str(obj.get("Type", "")) == "Catalog"
+        ),
+        None,
+    )
+    pages: List[dict] = []
+    seen: set = set()
+
+    def walk(node_ref):
+        if isinstance(node_ref, Ref):
+            if node_ref.num in seen:  # cycle guard
+                return
+            seen.add(node_ref.num)
+        node = doc.deref(node_ref)
+        if not isinstance(node, dict):
+            return
+        t = str(node.get("Type", ""))
+        if t == "Page":
+            pages.append(node)
+        elif t == "Pages" or "Kids" in node:
+            kids = doc.deref(node.get("Kids")) or []
+            for kid in kids:
+                walk(kid)
+
+    if catalog is not None:
+        walk(catalog.get("Pages"))
+    if not pages:  # fallback: no walkable tree
+        pages = [
+            obj
+            for _, obj in sorted(doc.objects.items())
+            if isinstance(obj, dict) and str(obj.get("Type", "")) == "Page"
+        ]
+    return pages
+
+
 def extract_text(data: bytes) -> str:
     """Whole-document text: per-page text joined with ``"\\n"`` (parity with
     the reference's ``process_pdf``, rag.py:47-52)."""
     doc = PdfDocument(data)
-    pages = [
-        (num, obj)
-        for num, obj in sorted(doc.objects.items())
-        if isinstance(obj, dict) and str(obj.get("Type", "")) == "Page"
-    ]
+    pages = _pages_in_reading_order(doc)
     texts: List[str] = []
-    for _, page in pages:
+    for page in pages:
         fonts = _page_fonts(doc, page)
         content = page.get("Contents")
         chunks: List[bytes] = []

@@ -74,6 +74,7 @@ class RagService:
         encoder: EncoderRunner,
         encoder_tokenizer,
         store: VectorStore,
+        scheduler=None,  # optional BatchScheduler: coalesces concurrent queries
     ):
         self.config = config
         self.engine = engine
@@ -81,6 +82,7 @@ class RagService:
         self.encoder = encoder
         self.encoder_tokenizer = encoder_tokenizer
         self.store = store
+        self.scheduler = scheduler
         self.metrics = _Metrics()
         self.ready = False
 
@@ -120,8 +122,11 @@ class RagService:
             return 0
         files = [f for f in sorted(os.listdir(pdf_dir)) if f.endswith(".pdf")]
         for fname in files:
-            with open(os.path.join(pdf_dir, fname), "rb") as f:
-                self.ingest_pdf_bytes(f.read(), fname)
+            try:
+                with open(os.path.join(pdf_dir, fname), "rb") as f:
+                    self.ingest_pdf_bytes(f.read(), fname)
+            except Exception:  # noqa: BLE001 — one bad PDF must not crashloop boot
+                logger.exception("failed to ingest %s; skipping", fname)
         if not files:
             logger.warning("No PDF files found in %s", pdf_dir)
         return len(files)
@@ -145,7 +150,10 @@ class RagService:
         context, prompt_ids = self._budgeted_prompt(user_prompt, results)
 
         t0 = time.monotonic()
-        out_ids = self.engine.generate([prompt_ids])[0]
+        if self.scheduler is not None:
+            out_ids = self.scheduler.submit(prompt_ids)
+        else:
+            out_ids = self.engine.generate([prompt_ids])[0]
         completion = self.llm_tokenizer.decode(out_ids)
         timings["generate_ms"] = (time.monotonic() - t0) * 1e3
         timings["total_ms"] = (time.monotonic() - t_all) * 1e3
@@ -171,6 +179,7 @@ class RagService:
             type(r)(metadata=dict(r.metadata), distance=r.distance)
             for r in results[: self.config.retrieval.context_top_n]
         ]
+        dropped, trimmed_to = 0, None
         while True:
             context = assemble_context(used, len(used))
             prompt = assemble_prompt(user_prompt, context, self.config.system_message)
@@ -178,24 +187,35 @@ class RagService:
             if not ids or ids[0] != bos:
                 ids = [bos] + ids
             if len(ids) <= budget:
+                if dropped or trimmed_to is not None:
+                    logger.warning(
+                        "prompt exceeded %d-token budget: dropped %d chunk(s)%s",
+                        budget, dropped,
+                        f", trimmed last chunk to {trimmed_to} words" if trimmed_to else "",
+                    )
                 return context, ids
             if len(used) > 1:
-                logger.warning("prompt over %d-token budget; dropping chunk %d", budget, len(used))
                 used.pop()
+                dropped += 1
             else:
                 words = used[0].metadata.get("text", "").split()
-                if len(words) < 40:  # give up: serve what fits via engine truncation
-                    logger.warning("prompt irreducibly over budget; hard truncating")
+                # proportional jump toward the budget (0.9 safety margin), so
+                # trimming converges in a couple of re-encodes, not O(n) passes
+                target = min(len(words) - 1, int(len(words) * budget / len(ids) * 0.9))
+                if target < 10:  # irreducible: serve what fits via truncation
+                    logger.warning("prompt irreducibly over %d-token budget; hard truncating", budget)
                     return context, ids[:1] + ids[1 + (len(ids) - budget):]
-                used[0].metadata["text"] = " ".join(words[: int(len(words) * 0.8)])
-                logger.warning("prompt over budget; trimming last chunk to %d words",
-                               int(len(words) * 0.8))
+                used[0].metadata["text"] = " ".join(words[:target])
+                trimmed_to = target
 
     # -- lifecycle ------------------------------------------------------
     def warmup(self):
         """Pre-compile the hot executables, then mark ready (the reference has
-        no readiness signal; first request pays full compile)."""
-        self.engine.warmup(batch_sizes=(1,), buckets=self.engine.engine_config.prompt_buckets[:2])
+        no readiness signal; first request pays full compile). ALL prompt
+        buckets warm — RAG prompts with a full 3-chunk context land in the
+        largest bucket, so warming only small buckets would leave the very
+        first production query paying the big compile."""
+        self.engine.warmup(batch_sizes=(1,), buckets=self.engine.engine_config.prompt_buckets)
         self.embed_texts(["warmup"])
         self.ready = True
 
@@ -226,6 +246,7 @@ class WsgiApp:
                 Rule("/index_info", endpoint="index_info", methods=["GET"]),
                 Rule("/healthz", endpoint="healthz", methods=["GET"]),
                 Rule("/metrics", endpoint="metrics", methods=["GET"]),
+                Rule("/profile", endpoint="profile", methods=["POST"]),
             ]
         )
 
@@ -285,6 +306,29 @@ class WsgiApp:
             }
         )
         return self._jsonify(snap)
+
+    def ep_profile(self, request):
+        """Capture a jax.profiler device trace around one sample query
+        (tracing/profiling subsystem — absent from the reference, survey §5).
+        Body: {"prompt": str?, "dir": str?, "seconds": float?}."""
+        try:
+            import jax
+
+            data = request.get_json(force=True, silent=True) or {}
+            trace_dir = data.get("dir", "/tmp/tpu_rag_trace")
+            prompt = data.get("prompt", "What is this document about?")
+            with jax.profiler.trace(trace_dir):
+                result = self.service.answer(prompt)
+            return self._jsonify(
+                {
+                    "trace_dir": trace_dir,
+                    "timings": result.get("timings"),
+                    "message": "trace captured; open with tensorboard or xprof",
+                }
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.exception("profile failed")
+            return self._jsonify({"error": str(e)}, 500)
 
     # -- WSGI plumbing --------------------------------------------------
     def __call__(self, environ, start_response):

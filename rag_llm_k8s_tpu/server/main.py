@@ -48,9 +48,39 @@ def build_service():
     if os.path.exists(os.path.join(model_dir, "config.json")):
         model_cfg = config_from_hf_json(model_dir)
     logger.info("loading Llama weights from %s", model_dir)
-    params = load_safetensors_params(
-        model_dir, model_cfg, config.dtypes, put=make_streaming_put(mesh, config.dtypes.param_dtype)
-    )
+
+    def _convert():
+        return load_safetensors_params(
+            model_dir,
+            model_cfg,
+            config.dtypes,
+            put=make_streaming_put(mesh, config.dtypes.param_dtype),
+        )
+
+    def _abstract():
+        import jax
+
+        from flax import traverse_util
+        from jax.sharding import NamedSharding
+
+        from rag_llm_k8s_tpu.models.llama import init_llama_params
+        from rag_llm_k8s_tpu.parallel.sharding import llama_param_specs
+
+        shapes = jax.eval_shape(
+            lambda: init_llama_params(jax.random.PRNGKey(0), model_cfg, config.dtypes)
+        )
+        specs = traverse_util.flatten_dict(llama_param_specs(shapes, mesh))
+        flat = {
+            path: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=NamedSharding(mesh.mesh, specs[path])
+            )
+            for path, leaf in traverse_util.flatten_dict(shapes).items()
+        }
+        return traverse_util.unflatten_dict(flat)
+
+    from rag_llm_k8s_tpu.models.checkpoint import load_params_cached
+
+    params = load_params_cached(model_dir, _convert, abstract_params_fn=_abstract)
     llm_tokenizer = load_tokenizer(model_dir)
 
     logger.info("loading bge-m3 from %s", config.server.embedder_path)
@@ -79,7 +109,12 @@ def build_service():
         config.server.index_path, dim=config.retrieval.embed_dim, fingerprint=fingerprint
     )
 
-    return RagService(config, engine, llm_tokenizer, encoder, enc_tokenizer, store)
+    from rag_llm_k8s_tpu.engine.batching import BatchScheduler
+
+    scheduler = BatchScheduler(engine)
+    return RagService(
+        config, engine, llm_tokenizer, encoder, enc_tokenizer, store, scheduler=scheduler
+    )
 
 
 def main():

@@ -106,6 +106,37 @@ class ByteLevelBPETokenizer:
         self._b2u = byte_to_unicode()
         self._u2b = unicode_to_byte()
         self._cache: Dict[str, List[int]] = {}
+        self._native = self._init_native()
+
+    def _init_native(self):
+        """Load the C++ merge loop (rag_llm_k8s_tpu/native/bpe.cpp); None ⇒
+        pure-Python fallback."""
+        try:
+            from rag_llm_k8s_tpu.native import load_library
+        except ImportError:
+            return None
+        import ctypes
+
+        lib = load_library("bpe")
+        if lib is None:
+            return None
+        lib.bpe_create.restype = ctypes.c_void_p
+        for fn in (lib.bpe_encode_word, lib.bpe_encode_words):
+            fn.restype = ctypes.c_int32
+            fn.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+            ]
+        handle = ctypes.c_void_p(lib.bpe_create())
+        for token, tid in self.vocab.items():
+            lib.bpe_add_token(handle, token.encode("utf-8"), ctypes.c_int32(tid))
+        for (a, b), rank in self.ranks.items():
+            lib.bpe_add_merge(
+                handle, a.encode("utf-8"), b.encode("utf-8"), ctypes.c_int32(rank)
+            )
+        return (lib, handle)
 
     @property
     def vocab_size(self) -> int:
@@ -119,6 +150,12 @@ class ByteLevelBPETokenizer:
         cached = self._cache.get(word)
         if cached is not None:
             return cached
+        if self._native is not None:
+            ids = self._bpe_word_native(word)
+            if ids is not None:
+                if len(self._cache) < 65536:
+                    self._cache[word] = ids
+                return ids
         parts = list(word)
         while len(parts) > 1:
             best_rank = None
@@ -142,13 +179,45 @@ class ByteLevelBPETokenizer:
             self._cache[word] = ids
         return ids
 
+    def _bpe_word_native(self, word: str) -> Optional[List[int]]:
+        import ctypes
+
+        lib, handle = self._native
+        buf_len = max(16, 2 * len(word) + 8)
+        buf = (ctypes.c_int32 * buf_len)()
+        n = lib.bpe_encode_word(handle, word.encode("utf-8"), buf, buf_len)
+        if n < 0:
+            return None  # overflow (pathological word) -> python path
+        return list(buf[:n])
+
     def _encode_ordinary(self, text: str) -> List[int]:
-        ids: List[int] = []
-        for m in self._pattern.finditer(text):
-            piece = m.group(0)
-            remapped = "".join(self._b2u[b] for b in piece.encode("utf-8"))
-            ids.extend(self._bpe_word(remapped))
-        return ids
+        remapped_words = [
+            "".join(self._b2u[b] for b in m.group(0).encode("utf-8"))
+            for m in self._pattern.finditer(text)
+        ]
+        if self._native is not None and remapped_words:
+            ids = self._encode_words_native(remapped_words)
+            if ids is not None:
+                return ids
+        out: List[int] = []
+        for word in remapped_words:
+            out.extend(self._bpe_word(word))
+        return out
+
+    def _encode_words_native(self, words: List[str]) -> Optional[List[int]]:
+        """One ctypes crossing for the whole text (bpe_encode_words)."""
+        import ctypes
+
+        lib, handle = self._native
+        joined = "\n".join(words).encode("utf-8")
+        buf_len = max(64, 2 * sum(len(w) for w in words) + 8)
+        for _ in range(2):
+            buf = (ctypes.c_int32 * buf_len)()
+            n = lib.bpe_encode_words(handle, joined, buf, buf_len)
+            if n >= 0:
+                return list(buf[:n])
+            buf_len *= 4
+        return None
 
     def encode(self, text: str, add_bos: bool = False, bos_id: Optional[int] = None) -> List[int]:
         """Encode, honoring special tokens embedded in the text (chat headers)."""

@@ -1,0 +1,63 @@
+"""Continuous batching: concurrent submits coalesce and return correct results."""
+
+import threading
+
+import jax
+import pytest
+
+from rag_llm_k8s_tpu.core.config import DTypePolicy, EngineConfig, LlamaConfig, SamplingConfig
+from rag_llm_k8s_tpu.engine.batching import BatchScheduler
+from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+from rag_llm_k8s_tpu.models.llama import init_llama_params
+
+FP32 = DTypePolicy.fp32()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = LlamaConfig.tiny()
+    params = init_llama_params(jax.random.PRNGKey(0), cfg, FP32)
+    return InferenceEngine(
+        cfg,
+        params,
+        sampling=SamplingConfig(do_sample=False, max_new_tokens=6),
+        engine_config=EngineConfig(prompt_buckets=(16,), max_batch_size=4),
+        dtypes=FP32,
+    )
+
+
+class TestBatchScheduler:
+    def test_concurrent_submits_match_solo(self, engine):
+        prompts = [[3, 1, 4], [1, 5, 9, 2], [6, 5], [3, 5, 8, 9, 7], [9, 3, 2], [3, 8]]
+        want = [engine.generate([p])[0] for p in prompts]
+
+        sched = BatchScheduler(engine, max_wait_ms=20.0)
+        calls_before = engine.stats.generate_calls
+        results = [None] * len(prompts)
+
+        def worker(i):
+            results[i] = sched.submit(prompts[i], timeout=120)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        sched.shutdown()
+
+        assert results == want
+        # 6 concurrent requests with cap 4 must coalesce into < 6 engine calls
+        assert engine.stats.generate_calls - calls_before < len(prompts)
+
+    def test_incompatible_max_new_not_mixed(self, engine):
+        sched = BatchScheduler(engine, max_wait_ms=20.0)
+        r_short = sched.submit([3, 1, 4], max_new_tokens=2, timeout=120)
+        r_long = sched.submit([3, 1, 4], max_new_tokens=5, timeout=120)
+        sched.shutdown()
+        assert len(r_short) <= 2 and len(r_long) <= 5
+
+    def test_shutdown_rejects(self, engine):
+        sched = BatchScheduler(engine)
+        sched.shutdown()
+        with pytest.raises(RuntimeError):
+            sched.submit([1, 2, 3])

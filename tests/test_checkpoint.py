@@ -1,0 +1,63 @@
+"""Orbax sharded param-cache round trip."""
+
+import jax
+import numpy as np
+import pytest
+
+from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
+from rag_llm_k8s_tpu.models.checkpoint import load_params_cached, restore_params, save_params
+from rag_llm_k8s_tpu.models.llama import init_llama_params
+
+FP32 = DTypePolicy.fp32()
+
+
+class TestParamCache:
+    def test_save_restore_roundtrip(self, tmp_path):
+        cfg = LlamaConfig.tiny()
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, FP32)
+        save_params(str(tmp_path / "ck"), params)
+        restored = restore_params(str(tmp_path / "ck"), params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            params,
+            restored,
+        )
+
+    def test_load_cached_populates_then_hits(self, tmp_path):
+        cfg = LlamaConfig.tiny()
+        params = init_llama_params(jax.random.PRNGKey(1), cfg, FP32)
+        calls = []
+
+        def convert():
+            calls.append(1)
+            return params
+
+        got1 = load_params_cached(
+            str(tmp_path), convert, abstract_params_fn=lambda: params
+        )
+        got2 = load_params_cached(
+            str(tmp_path), convert, abstract_params_fn=lambda: params
+        )
+        assert len(calls) == 1  # second load came from the cache
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            got1,
+            got2,
+        )
+
+    def test_sharded_restore(self, mesh_tp8):
+        """Restore places shards per the abstract tree's NamedShardings."""
+        import dataclasses
+        import tempfile
+
+        from rag_llm_k8s_tpu.parallel.sharding import shard_llama_params
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), num_heads=8, num_kv_heads=8, head_dim=8)
+        params = shard_llama_params(
+            init_llama_params(jax.random.PRNGKey(2), cfg, FP32), mesh_tp8
+        )
+        with tempfile.TemporaryDirectory() as d:
+            save_params(d + "/ck", params)
+            restored = restore_params(d + "/ck", params)
+        wq = restored["layers"]["attn"]["wq"]["kernel"]
+        assert wq.sharding == params["layers"]["attn"]["wq"]["kernel"].sharding

@@ -1,0 +1,59 @@
+"""Flash-attention kernel numerics (interpret mode on CPU) vs dense oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rag_llm_k8s_tpu.ops.attention import attention_xla, flash_attention
+
+
+def _problem(seed, B=2, S=256, H=4, K=2, hd=64, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, S, H, hd), dtype)
+    k = jax.random.normal(ks[1], (B, S, K, hd), dtype)
+    v = jax.random.normal(ks[2], (B, S, K, hd), dtype)
+    return q, k, v
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, causal):
+        q, k, v = _problem(0)
+        got = flash_attention(q, k, v, causal=causal, bq=64, bk=64, interpret=True)
+        want = attention_xla(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+    def test_left_pad_window(self):
+        """kv_start models the engine's left-padded rows; valid rows match."""
+        q, k, v = _problem(1)
+        B, S = q.shape[:2]
+        kv_start = jnp.array([0, 37], jnp.int32)
+        got = flash_attention(q, k, v, kv_start=kv_start, causal=True, bq=64, bk=64, interpret=True)
+        want = attention_xla(q, k, v, kv_start=kv_start, causal=True)
+        valid = (jnp.arange(S)[None, :] >= kv_start[:, None])[:, :, None, None]
+        np.testing.assert_allclose(
+            np.asarray(jnp.where(valid, got, 0)),
+            np.asarray(jnp.where(valid, want, 0)),
+            rtol=2e-4,
+            atol=2e-5,
+        )
+
+    def test_kv_len_frontier(self):
+        q, k, v = _problem(2)
+        kv_len = jnp.array([256, 150], jnp.int32)
+        got = flash_attention(q, k, v, kv_len=kv_len, causal=False, bq=64, bk=64, interpret=True)
+        want = attention_xla(q, k, v, kv_len=kv_len, causal=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+    def test_gqa_head_mapping(self):
+        q, k, v = _problem(3, H=8, K=2)
+        got = flash_attention(q, k, v, causal=True, bq=64, bk=64, interpret=True)
+        want = attention_xla(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+    def test_rectangular_blocks(self):
+        q, k, v = _problem(4, S=128)
+        got = flash_attention(q, k, v, causal=True, bq=32, bk=128, interpret=True)
+        want = attention_xla(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)

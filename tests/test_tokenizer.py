@@ -112,3 +112,39 @@ class TestUnigramParity:
         _, ours = pair
         ids = ours.encode("x+1", add_special=False)
         assert ours.unk_id in ids  # '+' is not in the trained vocab
+
+
+class TestNativeBPE:
+    def test_native_matches_python(self, tmp_path):
+        """The C++ merge loop must produce identical ids to the Python path."""
+        from tokenizers import Tokenizer
+        from tokenizers.models import BPE
+        from tokenizers.pre_tokenizers import ByteLevel
+        from tokenizers.trainers import BpeTrainer
+
+        tok = Tokenizer(BPE(unk_token=None))
+        tok.pre_tokenizer = ByteLevel(add_prefix_space=False, use_regex=True)
+        tok.train_from_iterator(
+            CORPUS,
+            BpeTrainer(
+                vocab_size=300,
+                initial_alphabet=ByteLevel.alphabet(),
+                show_progress=False,
+            ),
+        )
+        p = tmp_path / "tokenizer.json"
+        tok.save(str(p))
+        ours = load_tokenizer(str(p))
+        if ours._native is None:
+            pytest.skip("no C++ toolchain in this environment")
+        for text in SAMPLES + ["unicode: café — naïve", "x" * 500]:
+            native_ids = ours.encode(text)
+            nat = ours._native
+            ours._native = None
+            ours._cache.clear()
+            try:
+                python_ids = ours.encode(text)
+            finally:
+                ours._native = nat
+            assert native_ids == python_ids, text
+            assert native_ids == tok.encode(text).ids, text
