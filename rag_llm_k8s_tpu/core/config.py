@@ -248,6 +248,9 @@ class EngineConfig:
     prompt_buckets: Tuple[int, ...] = (256, 512, 1024, 2048, 4096)
     # hard cap on prompt bucket + generated tokens (KV-cache budget)
     max_seq_len: int = 4096 + 256
+    # attention backend: "auto" = fused Pallas kernels on TPU, XLA einsum
+    # oracle elsewhere (see models.llama.Attention)
+    attn_impl: str = "auto"
 
 
 @dataclass(frozen=True)

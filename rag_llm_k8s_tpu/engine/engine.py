@@ -44,9 +44,8 @@ from rag_llm_k8s_tpu.core.mesh import MeshContext
 from rag_llm_k8s_tpu.engine.sampling import sample_token
 from rag_llm_k8s_tpu.models.llama import (
     LlamaModel,
-    causal_bias,
-    decode_bias,
     make_kv_cache,
+    mask_window,
 )
 from rag_llm_k8s_tpu.utils.buckets import bucket_len, next_pow2
 
@@ -85,7 +84,12 @@ class InferenceEngine:
         self.dtypes = dtypes
         self.mesh = mesh
         self.pad_id = pad_id
-        self.model = LlamaModel(config, dtypes)
+        self.model = LlamaModel(
+            config,
+            dtypes,
+            attn_impl=engine_config.attn_impl,
+            mesh=(mesh.mesh if mesh is not None and mesh.tp > 1 else None),
+        )
         self._compiled: Dict[Tuple[int, int, int], jax.stages.Compiled] = {}
         self._lock = threading.Lock()
         self._rng_counter = 0
@@ -97,48 +101,49 @@ class InferenceEngine:
     def _build_generate(self, B: int, S: int, max_new: int):
         cfg, dt, sampling = self.config, self.dtypes, self.sampling
         model = self.model
+        # cache length rounds up to a 128 multiple so the fused decode kernel
+        # tiles it exactly; slots past S + max_new never enter any kv window
         T = S + max_new
+        if T > 128:
+            T = -(-T // 128) * 128
         eos_ids = cfg.eos_token_ids
         cache_dtype = dt.compute_dtype
         pad_id = self.pad_id
 
         def gen(params, tokens, pad_mask, rng):
             cache = make_kv_cache(cfg, B, T, cache_dtype)
-            bias = causal_bias(pad_mask, T, 0)
+            kv_start, _ = mask_window(pad_mask)  # left-pad: [S - real_len, S)
             real_len = jnp.sum(pad_mask, axis=-1)  # [B]
             positions = jnp.clip(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
             logits, cache = model.apply(
-                {"params": params}, tokens, positions, cache, bias, jnp.int32(0),
+                {"params": params}, tokens, positions, cache,
+                kv_start, jnp.full((B,), S, jnp.int32), jnp.int32(0),
                 last_logit_only=True,
             )
             rng, k0 = jax.random.split(rng)
             tok0 = sample_token(k0, logits[:, -1], sampling)
             done0 = _isin(tok0, eos_ids)
             out0 = jnp.full((B, max_new), pad_id, jnp.int32).at[:, 0].set(tok0)
-            key_valid0 = (
-                jnp.concatenate(
-                    [pad_mask.astype(bool), jnp.zeros((B, max_new), bool)], axis=1
-                )
-                .at[:, S]
-                .set(True)
-            )
 
             def cond(c):
-                step, _, _, done, _, _, _ = c
+                step, _, _, done, _, _ = c
                 return (step < max_new) & ~jnp.all(done)
 
             def body(c):
-                step, cache, last_tok, done, key_valid, out, rng = c
+                step, cache, last_tok, done, out, rng = c
                 # feed token sampled at step-1: cache slot S+step-1, position real_len+step-1
                 write_index = (S + step - 1).astype(jnp.int32)
                 pos = (real_len + step - 1)[:, None].astype(jnp.int32)
-                bias = decode_bias(key_valid)
+                # the fed token's slot is written this call, so the valid
+                # window runs through it: [kv_start, write_index + 1)
+                kv_len = jnp.broadcast_to((write_index + 1).astype(jnp.int32), (B,))
                 logits, cache = model.apply(
                     {"params": params},
                     last_tok[:, None],
                     pos,
                     cache,
-                    bias,
+                    kv_start,
+                    kv_len,
                     write_index,
                 )
                 rng, k = jax.random.split(rng)
@@ -146,13 +151,10 @@ class InferenceEngine:
                 tok = jnp.where(done, jnp.int32(eos_ids[0]), tok)
                 done = done | _isin(tok, eos_ids)
                 out = out.at[:, step].set(tok)
-                key_valid = key_valid.at[:, S + step].set(True)
-                return (step + 1, cache, tok, done, key_valid, out, rng)
+                return (step + 1, cache, tok, done, out, rng)
 
-            # key_valid slot for each fed token is set before its step runs, so
-            # the fed token attends to itself through the freshly written cache
-            init = (jnp.int32(1), cache, tok0, done0, key_valid0, out0, rng)
-            _, _, _, _, _, out, _ = jax.lax.while_loop(cond, body, init)
+            init = (jnp.int32(1), cache, tok0, done0, out0, rng)
+            _, _, _, _, out, _ = jax.lax.while_loop(cond, body, init)
             return out
 
         # AOT-compile from abstract shapes (no execution)

@@ -16,21 +16,23 @@ import jax.numpy as jnp
 import optax
 
 from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
-from rag_llm_k8s_tpu.models.llama import LlamaModel, causal_bias, make_kv_cache
+from rag_llm_k8s_tpu.models.llama import LlamaModel, make_kv_cache, mask_window
 
 
 def lm_loss(
     model: LlamaModel,
     params,
     tokens: jax.Array,  # [B, S]
-    mask: jax.Array,  # [B, S] 1 = real token
+    mask: jax.Array,  # [B, S] 1 = real token (contiguous run, e.g. right-pad)
 ) -> jax.Array:
     """Next-token cross entropy, fp32, masked mean."""
     B, S = tokens.shape
     cache = make_kv_cache(model.config, B, S, model.dtypes.compute_dtype)
-    bias = causal_bias(mask, S, 0)
+    kv_start, kv_len = mask_window(mask)
     positions = jnp.clip(jnp.cumsum(mask, axis=-1) - 1, 0)
-    logits, _ = model.apply({"params": params}, tokens, positions, cache, bias, jnp.int32(0))
+    logits, _ = model.apply(
+        {"params": params}, tokens, positions, cache, kv_start, kv_len, jnp.int32(0)
+    )
     logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
     targets = tokens[:, 1:]
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
@@ -47,7 +49,9 @@ def make_train_step(
     sharding-transparent: with TP/DP-placed params and dp-sharded batches, XLA
     emits the ICI collectives (grad psum over dp, activation collectives over
     tp) — no pmap, no hand-written comms."""
-    model = LlamaModel(config, dtypes)
+    # "xla" attention: the dense-einsum path is the differentiable one (the
+    # Pallas kernels are inference-only, no custom VJP)
+    model = LlamaModel(config, dtypes, attn_impl="xla")
     opt = optimizer or optax.adamw(1e-5)
 
     def init_opt_state(params):

@@ -33,8 +33,15 @@ import flax.linen as nn
 import flax.struct
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
 
 from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
+from rag_llm_k8s_tpu.ops.attention import (
+    attention_xla,
+    decode_attention,
+    decode_attention_xla,
+    flash_attention,
+)
 
 # ---------------------------------------------------------------------------
 # KV cache
@@ -45,10 +52,13 @@ from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
 class KVCache:
     """Per-model KV cache: stacked over layers, written at a shared index.
 
-    Shapes: ``k, v: [L, B, T_max, kv_heads, head_dim]``. Prompts are
-    LEFT-padded by the engine so every sequence in the batch appends at the
-    same ``write_index`` — cache updates stay a ``dynamic_update_slice``
-    (scatter-free, MXU/DMA friendly) instead of a per-row scatter.
+    Shapes: ``k, v: [L, B, kv_heads, T_max, head_dim]`` — HEAD-MAJOR, so the
+    decode kernel streams contiguous ``(block, head_dim)`` slabs per kv head
+    straight from HBM (perfect VMEM tiling, no cache transposition ever).
+    Prompts are LEFT-padded by the engine so every sequence in the batch
+    appends at the same ``write_index`` — cache updates stay a
+    ``dynamic_update_slice`` (scatter-free, MXU/DMA friendly) instead of a
+    per-row scatter.
     """
 
     k: jax.Array
@@ -64,8 +74,8 @@ def make_kv_cache(
     shape = (
         config.num_layers,
         batch_size,
-        max_seq_len,
         config.num_kv_heads,
+        max_seq_len,
         config.head_dim,
     )
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
@@ -137,15 +147,97 @@ class RMSNorm(nn.Module):
 
 
 class Attention(nn.Module):
+    """GQA attention with two fused TPU paths and one differentiable oracle.
+
+    - prefill / training (``S > 1``, ``write_index == 0``): blockwise Pallas
+      flash attention over the FRESH ``[B, S, K, hd]`` keys/values — never the
+      T-length cache, never a materialized score or bias array;
+    - decode (``S == 1``): fused Pallas kernel streaming the head-major
+      ``[B, K, T, hd]`` cache with the flash recurrence;
+    - ``attn_impl="xla"``: dense einsum oracle (differentiable — the training
+      path; also the CPU-test oracle the kernels are validated against).
+
+    Masking is two ``[B]`` int32 vectors (``kv_start``, ``kv_len`` — the valid
+    contiguous window) plus causality over cache slots. The reference's torch
+    path and round 1's einsum both materialized a full ``[B, 1, S, T]`` fp32
+    bias (~71 MB/row at the 4096 bucket); here no mask array exists at all.
+    """
+
     config: LlamaConfig
     dtypes: DTypePolicy
+    attn_impl: str = "auto"  # "auto" | "pallas" | "pallas_interpret" | "xla"
+    mesh: Optional[Mesh] = None  # enables shard_map-over-heads TP for kernels
+
+    def _resolved_impl(self) -> str:
+        if self.attn_impl == "auto":
+            return "pallas" if jax.default_backend() == "tpu" else "xla"
+        return self.attn_impl
+
+    def _attend(self, q, k, v, kv_start, kv_len, layer, *, decode: bool) -> jax.Array:
+        """Dispatch to the right backend; for decode, ``k``/``v`` are the FULL
+        stacked head-major cache ``[L, B, K, T, hd]`` read at ``layer`` (no
+        per-layer slice is ever materialized), otherwise fresh
+        ``[B, S, K, hd]``."""
+        impl = self._resolved_impl()
+        if impl == "xla":
+            if decode:
+                return decode_attention_xla(q, k, v, kv_start, kv_len, layer)
+            return attention_xla(q, k, v, kv_start=kv_start, kv_len=kv_len, causal=True)
+
+        interpret = impl == "pallas_interpret"
+        if decode:
+            kernel = lambda q_, k_, v_, s_, l_, lay_: decode_attention(  # noqa: E731
+                q_, k_, v_, s_, l_, lay_, interpret=interpret
+            )
+        else:
+            kernel = lambda q_, k_, v_, s_, l_: flash_attention(  # noqa: E731
+                q_, k_, v_, s_, l_, causal=True, interpret=interpret
+            )
+
+        mesh = self.mesh
+        # kv heads sit at dim 2 in both layouts ([L,B,K,T,hd] / [B,S,K,hd])
+        H, K = q.shape[2], k.shape[2]
+        if (
+            mesh is not None
+            and "tp" in mesh.axis_names
+            and mesh.shape["tp"] > 1
+            and H % mesh.shape["tp"] == 0
+            and K % mesh.shape["tp"] == 0
+        ):
+            # heads are independent: shard the kernel over the tp axis, one
+            # per-device Pallas call each on its local heads — no collectives
+            from jax.experimental.shard_map import shard_map
+
+            hspec = P(None, None, "tp", None)
+            if decode:
+                kvspec = P(None, None, "tp", None, None)
+                kernel = shard_map(
+                    kernel,
+                    mesh=mesh,
+                    in_specs=(hspec, kvspec, kvspec, P(None), P(None), P(None)),
+                    out_specs=hspec,
+                    check_rep=False,
+                )
+            else:
+                kernel = shard_map(
+                    kernel,
+                    mesh=mesh,
+                    in_specs=(hspec, hspec, hspec, P(None), P(None)),
+                    out_specs=hspec,
+                    check_rep=False,
+                )
+        if decode:
+            return kernel(q, k, v, kv_start, kv_len, jnp.asarray(layer, jnp.int32).reshape(1))
+        return kernel(q, k, v, kv_start, kv_len)
 
     @nn.compact
     def __call__(
         self,
         x: jax.Array,  # [B, S, D]
-        kv: Tuple[jax.Array, jax.Array],  # layer cache [B, T, K, hd] ×2
-        bias: jax.Array,  # [B, 1, S, T] additive fp32 mask
+        kv: Tuple[jax.Array, jax.Array],  # FULL stacked cache [L, B, K, T, hd] ×2
+        layer: jax.Array,  # scalar int32: this block's layer index
+        kv_start: jax.Array,  # [B] int32: first valid cache slot
+        kv_len: jax.Array,  # [B] int32: valid frontier (exclusive)
         cos: jax.Array,
         sin: jax.Array,
         write_index: jax.Array,  # scalar int32
@@ -153,7 +245,6 @@ class Attention(nn.Module):
         c, dt = self.config, self.dtypes
         B, S, D = x.shape
         H, K, hd = c.num_heads, c.num_kv_heads, c.head_dim
-        G = H // K
         dense = lambda feats, name: nn.Dense(  # noqa: E731
             feats, use_bias=False, dtype=dt.compute_dtype, param_dtype=dt.param_dtype, name=name
         )
@@ -164,21 +255,35 @@ class Attention(nn.Module):
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
-        k_cache, v_cache = kv
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, write_index, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, write_index, 0, 0))
+        # in-place slice write into the ONE persistent cache buffer: the
+        # stacked [L, ...] cache is a scan carry, so XLA aliases it across
+        # layers and decode steps — no cache-sized copy ever happens (the
+        # naive per-layer-output stacking costs GB/step of pure copy traffic)
+        k_cache, v_cache = kv  # [L, B, K, T, hd]
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache,
+            k.transpose(0, 2, 1, 3).astype(k_cache.dtype)[None],
+            (layer, 0, 0, write_index, 0),
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache,
+            v.transpose(0, 2, 1, 3).astype(v_cache.dtype)[None],
+            (layer, 0, 0, write_index, 0),
+        )
 
-        # grouped-query attention: [B,S,K,G,hd] x [B,T,K,hd] -> [B,K,G,S,T]
-        qg = q.reshape(B, S, K, G, hd)
-        scores = jnp.einsum(
-            "bskgd,btkd->bkgst", qg, k_cache, preferred_element_type=jnp.float32
-        )
-        scores = scores * (hd ** -0.5) + bias[:, :, None, :, :]  # [B,1,1,S,T] broadcast
-        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-        out = jnp.einsum(
-            "bkgst,btkd->bskgd", probs.astype(dt.compute_dtype), v_cache,
-            preferred_element_type=jnp.float32,
-        )
+        if S == 1:
+            out = self._attend(q, k_cache, v_cache, kv_start, kv_len, layer, decode=True)
+        else:
+            # prefill/training writes at slot 0, so the fresh K/V ARE the
+            # populated cache prefix — attend over S keys, not T cache slots.
+            # Chunked prefill (S > 1 at write_index > 0) is NOT supported by
+            # this path; fail loudly when the index is concrete.
+            if not isinstance(write_index, jax.core.Tracer):
+                assert int(write_index) == 0, (
+                    "multi-token calls must write at slot 0 (chunked prefill "
+                    "at write_index > 0 would need cache-wide attention)"
+                )
+            out = self._attend(q, k, v, kv_start, kv_len, layer, decode=False)
         out = out.astype(dt.compute_dtype).reshape(B, S, H * hd)
         return dense(D, "wo")(out), (k_cache, v_cache)
 
@@ -199,35 +304,52 @@ class MLP(nn.Module):
 
 
 class Block(nn.Module):
+    """One decoder layer, written as an ``nn.scan`` body: the carry threads
+    ``(h, full_kv_cache, layer_idx)`` through the stack so the cache is ONE
+    in-place-updated buffer, never a per-layer scan output re-stacked each
+    call (which would copy the whole multi-GB cache every decode step)."""
+
     config: LlamaConfig
     dtypes: DTypePolicy
+    attn_impl: str = "auto"
+    mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, h, kv, bias, cos, sin, write_index):
-        attn_out, kv = Attention(self.config, self.dtypes, name="attn")(
+    def __call__(self, carry, kv_start, kv_len, cos, sin, write_index):
+        h, kv, layer = carry
+        attn_out, kv = Attention(
+            self.config, self.dtypes, self.attn_impl, self.mesh, name="attn"
+        )(
             RMSNorm(self.config.rms_norm_eps, self.dtypes, name="input_norm")(h),
-            kv, bias, cos, sin, write_index,
+            kv, layer, kv_start, kv_len, cos, sin, write_index,
         )
         h = h + attn_out
         h = h + MLP(self.config, self.dtypes, name="mlp")(
             RMSNorm(self.config.rms_norm_eps, self.dtypes, name="post_attn_norm")(h)
         )
-        return h, kv
+        return (h, kv, layer + 1), None
 
 
 class LlamaModel(nn.Module):
     """The full decoder. One call signature for training, prefill and decode:
 
-    ``(tokens [B,S], positions [B,S], cache, bias [B,1,S,T], write_index)``
-    → ``(logits [B,S,V] fp32, new_cache)``.
+    ``(tokens [B,S], positions [B,S], cache, kv_start [B], kv_len [B],
+    write_index)`` → ``(logits [B,S,V] fp32, new_cache)``.
 
-    - training / logit-eval: ``T == S``, ``write_index = 0``, causal bias;
-    - prefill: bucketed ``S``, ``T = max_seq``, ``write_index = 0``;
-    - decode: ``S = 1``, ``write_index = t``.
+    ``[kv_start, kv_len)`` is the contiguous window of valid cache slots per
+    row (left-padded serving: ``[S - real_len, S)``; right-padded training:
+    ``[0, real_len)`` — see ``mask_window``); causality over cache slots is
+    applied on top. No mask/bias array is ever materialized.
+
+    - training / logit-eval: ``T == S``, ``write_index = 0``;
+    - prefill: bucketed ``S``, ``write_index = 0``, ``kv_len = S``;
+    - decode: ``S = 1``, ``write_index = t``, ``kv_len = t + 1``.
     """
 
     config: LlamaConfig
     dtypes: DTypePolicy = DTypePolicy()
+    attn_impl: str = "auto"  # see Attention.attn_impl ("xla" = differentiable)
+    mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(
@@ -235,7 +357,8 @@ class LlamaModel(nn.Module):
         tokens: jax.Array,
         positions: jax.Array,
         cache: KVCache,
-        bias: jax.Array,
+        kv_start: jax.Array,
+        kv_len: jax.Array,
         write_index: jax.Array,
         last_logit_only: bool = False,
     ) -> Tuple[jax.Array, KVCache]:
@@ -254,12 +377,12 @@ class LlamaModel(nn.Module):
             Block,
             variable_axes={"params": 0},
             split_rngs={"params": True},
-            in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
             out_axes=0,
             length=c.num_layers,
         )
-        h, (new_k, new_v) = ScanBlocks(c, dt, name="layers")(
-            h, (cache.k, cache.v), bias, cos, sin, write_index
+        (h, (new_k, new_v), _), _ = ScanBlocks(c, dt, self.attn_impl, self.mesh, name="layers")(
+            (h, (cache.k, cache.v), jnp.int32(0)), kv_start, kv_len, cos, sin, write_index
         )
 
         h = RMSNorm(c.rms_norm_eps, dt, name="final_norm")(h)
@@ -290,34 +413,17 @@ class LlamaModel(nn.Module):
 # masks + init
 # ---------------------------------------------------------------------------
 
-NEG_INF = -1e9  # large-negative (not -inf: keeps softmax NaN-free on all-masked rows)
 
+def mask_window(pad_mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """``[B, S]`` contiguous 0/1 pad mask → ``(kv_start, kv_len)`` ``[B]``.
 
-def causal_bias(
-    pad_mask: jax.Array,  # [B, S] 1 = real token, 0 = pad
-    total_len: int,
-    write_index: int = 0,
-) -> jax.Array:
-    """Additive attention bias ``[B, 1, S, T]`` for a prefill/training call
-    writing S tokens at ``write_index`` into a T-length cache: query i may see
-    cache slots ``<= write_index + i`` that hold real tokens."""
-    B, S = pad_mask.shape
-    q_pos = write_index + jnp.arange(S)[:, None]  # [S, 1]
-    t_pos = jnp.arange(total_len)[None, :]  # [1, T]
-    causal = t_pos <= q_pos  # [S, T]
-    # key slots beyond what's been written are invalid; pads within the
-    # written prefix are masked via the key-side pad mask
-    key_pad = jnp.ones((B, total_len), dtype=bool)
-    key_pad = jax.lax.dynamic_update_slice(key_pad, pad_mask.astype(bool), (0, write_index))
-    ok = causal[None, :, :] & key_pad[:, None, :]
-    return jnp.where(ok, 0.0, NEG_INF).astype(jnp.float32)[:, None, :, :]
-
-
-def decode_bias(
-    key_valid: jax.Array,  # [B, T] bool: slot holds a real (non-pad) token
-) -> jax.Array:
-    """Additive bias ``[B, 1, 1, T]`` for single-token decode."""
-    return jnp.where(key_valid[:, None, None, :], 0.0, NEG_INF).astype(jnp.float32)
+    The whole system only ever produces contiguous valid windows (the engine
+    left-pads, training right-pads), so a mask reduces to two scalars per row
+    — replacing the reference-era materialized ``[B, 1, S, T]`` bias arrays.
+    """
+    m = pad_mask.astype(jnp.int32)
+    start = jnp.argmax(m, axis=-1).astype(jnp.int32)  # first valid slot (0 if none)
+    return start, start + jnp.sum(m, axis=-1).astype(jnp.int32)
 
 
 def init_llama_params(
@@ -327,11 +433,11 @@ def init_llama_params(
 ):
     """Random-init parameter pytree (tests, benchmarks; real weights come from
     the safetensors loader in ``models/loader.py``)."""
-    model = LlamaModel(config, dtypes)
+    model = LlamaModel(config, dtypes, attn_impl="xla")
     B, S = 1, 8
     cache = make_kv_cache(config, B, S, dtypes.compute_dtype)
     tokens = jnp.zeros((B, S), jnp.int32)
     positions = jnp.zeros((B, S), jnp.int32)
-    bias = jnp.zeros((B, 1, S, S), jnp.float32)
-    variables = model.init(rng, tokens, positions, cache, bias, jnp.int32(0))
+    window = jnp.zeros((B,), jnp.int32), jnp.full((B,), S, jnp.int32)
+    variables = model.init(rng, tokens, positions, cache, *window, jnp.int32(0))
     return variables["params"]

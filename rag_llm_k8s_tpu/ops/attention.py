@@ -29,6 +29,15 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _fit_block(n: int, pref: int) -> int:
+    """Largest block ≤ ``pref`` that tiles ``n`` exactly (halves until it
+    divides; terminates at 1)."""
+    b = min(pref, n)
+    while n % b:
+        b //= 2
+    return b
+
+
 def _flash_kernel(
     kv_start_ref,  # SMEM [B]
     kv_len_ref,  # SMEM [B]
@@ -58,9 +67,11 @@ def _flash_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal skip: a K block strictly above this Q block's diagonal is fully
-    # masked — skip its matmuls entirely (halves causal prefill work)
-    live = (kj * bk <= qi * bq + bq - 1) if causal else True
+    # block skip: fully-masked K blocks do no work — strictly above the
+    # causal diagonal (halves causal prefill), entirely inside the left-pad
+    # region (< kv_start), or entirely past the valid frontier (>= kv_len)
+    overlap = (kj * bk + bk > kv_start_ref[b]) & (kj * bk < kv_len_ref[b])
+    live = (overlap & (kj * bk <= qi * bq + bq - 1)) if causal else overlap
 
     @pl.when(live)
     def _compute():
@@ -108,16 +119,22 @@ def flash_attention(
     kv_start: Optional[jax.Array] = None,  # [B] int32 (left-pad offset)
     kv_len: Optional[jax.Array] = None,  # [B] int32 (valid frontier)
     causal: bool = True,
-    bq: int = 128,
-    bk: int = 128,
+    bq: int = 256,
+    bk: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
-    """Blockwise fused attention; returns ``[B, Sq, H, hd]`` in q's dtype."""
+    """Blockwise fused attention; returns ``[B, Sq, H, hd]`` in q's dtype.
+
+    Default blocks are deliberately coarse (256×512): the TPU grid runs
+    sequentially, so per-step overhead is amortized by doing more MXU work
+    per step; VMEM stays comfortable (≤ ~1 MB/block at hd=128). Blocks
+    shrink (halving) until they tile the sequence exactly, so any
+    power-of-two-divisible length works."""
     B, Sq, H, hd = q.shape
     _, Sk, K, _ = k.shape
     G = H // K
-    bq = min(bq, Sq)
-    bk = min(bk, Sk)
+    bq = _fit_block(Sq, bq)
+    bk = _fit_block(Sk, bk)
     assert Sq % bq == 0 and Sk % bk == 0, (Sq, bq, Sk, bk)
     if kv_start is None:
         kv_start = jnp.zeros((B,), jnp.int32)
@@ -163,6 +180,177 @@ def flash_attention(
     )(kv_start.astype(jnp.int32), kv_len.astype(jnp.int32), qt, kt, vt)
 
     return out.reshape(B, H, Sq, hd).transpose(0, 2, 1, 3)
+
+
+def _decode_kernel(
+    layer_ref,  # SMEM [1]
+    kv_start_ref,  # SMEM [B]
+    kv_len_ref,  # SMEM [B]
+    q_ref,  # [1, K, G, hd]
+    k_ref,  # [1, 1, K, bk, hd]
+    v_ref,  # [1, 1, K, bk, hd]
+    o_ref,  # [1, K, G, hd]
+    m_scr,  # VMEM [K, G, 1]
+    l_scr,  # VMEM [K, G, 1]
+    acc_scr,  # VMEM [K, G, hd]
+    *,
+    bk: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    kj = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # skip blocks entirely outside the row's valid [kv_start, kv_len) window
+    blk_lo = kj * bk
+    live = (blk_lo < kv_len_ref[b]) & (blk_lo + bk > kv_start_ref[b])
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]  # [K, G, hd]
+        k = k_ref[0, 0]  # [K, bk, hd]
+        v = v_ref[0, 0]
+        # one batched dot over all kv heads: [K, G, hd] x [K, bk, hd] -> [K, G, bk]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale
+
+        k_pos = blk_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        ok = (k_pos >= kv_start_ref[b]) & (k_pos < kv_len_ref[b])
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(ok, jnp.exp(s - m_new), 0.0)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=2, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _decode_block(T: int, bk: int) -> int:
+    """Largest K/V block ≤ ``bk`` that tiles ``T`` exactly (T is a multiple of
+    128 by engine construction; tiny tests may pass smaller T = single block)."""
+    if T <= bk:
+        return T
+    for cand in (512, 384, 256, 128):
+        if cand <= bk and T % cand == 0:
+            return cand
+    return T  # single block fallback (T < 128)
+
+
+@functools.partial(jax.jit, static_argnames=("bk", "interpret"))
+def decode_attention(
+    q: jax.Array,  # [B, 1, H, hd] — the single fresh query token
+    k_cache: jax.Array,  # [L, B, K, T, hd] — FULL stacked head-major cache
+    v_cache: jax.Array,  # [L, B, K, T, hd]
+    kv_start: jax.Array,  # [B] int32: first valid cache slot (left-pad offset)
+    kv_len: jax.Array,  # [B] int32: valid frontier (exclusive)
+    layer: jax.Array,  # [] or [1] int32: which layer's cache to attend over
+    bk: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused single-token decode attention over the KV cache.
+
+    Replaces the reference's per-step torch attention inside ``model.generate``
+    (/root/reference/llm/rag.py:172). The kernel reads ITS OWN layer straight
+    out of the full stacked cache — ``layer`` rides scalar prefetch into the
+    block index map, so no per-layer slice of the multi-GB cache is ever
+    materialized. One grid cell per batch row: all K kv heads' blocks stream
+    together (one batched MXU dot per block — the grid stays coarse so
+    per-step kernel overhead never dominates the bandwidth-bound cache scan),
+    with the flash recurrence across blocks; blocks outside
+    ``[kv_start, kv_len)`` are compute-skipped. The ``[.., K, T, hd]`` layout
+    makes every block K contiguous ``(bk, hd)`` slabs — tiled exactly for the
+    VPU/MXU, no transposition of cache memory ever happens.
+    """
+    B, S, H, hd = q.shape
+    assert S == 1, f"decode_attention is single-token (got S={S})"
+    L, _, K, T, _ = k_cache.shape
+    G = H // K
+    bk = _decode_block(T, bk)
+    assert T % bk == 0, (T, bk)
+
+    qh = q.reshape(B, K, G, hd)
+    grid = (B, T // bk)
+
+    def kv_index(b, kj, layer_ref, *s_):
+        return (layer_ref[0], b, 0, kj, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, bk=bk, scale=hd**-0.5),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, K, G, hd), lambda b, kj, *s_: (b, 0, 0, 0)),
+                pl.BlockSpec((1, 1, K, bk, hd), kv_index),
+                pl.BlockSpec((1, 1, K, bk, hd), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, K, G, hd), lambda b, kj, *s_: (b, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((K, G, 1), jnp.float32),
+                pltpu.VMEM((K, G, 1), jnp.float32),
+                pltpu.VMEM((K, G, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, K, G, hd), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        kv_start.astype(jnp.int32),
+        kv_len.astype(jnp.int32),
+        qh,
+        k_cache,
+        v_cache,
+    )
+
+    return out.reshape(B, 1, H, hd)
+
+
+def decode_attention_xla(
+    q: jax.Array,  # [B, 1, H, hd]
+    k_cache: jax.Array,  # [L, B, K, T, hd]
+    v_cache: jax.Array,  # [L, B, K, T, hd]
+    kv_start: jax.Array,  # [B]
+    kv_len: jax.Array,  # [B]
+    layer: jax.Array,  # [] or [1] int32
+) -> jax.Array:
+    """Dense XLA reference for ``decode_attention`` (oracle; fallback off-TPU)."""
+    B, S, H, hd = q.shape
+    _, _, K, T, _ = k_cache.shape
+    G = H // K
+    lay = jnp.asarray(layer, jnp.int32).reshape(())
+    k_cache = jax.lax.dynamic_index_in_dim(k_cache, lay, 0, keepdims=False)
+    v_cache = jax.lax.dynamic_index_in_dim(v_cache, lay, 0, keepdims=False)
+    qg = q.reshape(B, K, G, hd)
+    s = jnp.einsum(
+        "bkgd,bktd->bkgt", qg, k_cache, preferred_element_type=jnp.float32
+    ) * (hd**-0.5)
+    t_pos = jnp.arange(T)
+    ok = (t_pos[None, :] >= kv_start[:, None]) & (t_pos[None, :] < kv_len[:, None])
+    s = jnp.where(ok[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(ok[:, None, None, :], p, 0.0)
+    o = jnp.einsum(
+        "bkgt,bktd->bkgd", p.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return o.reshape(B, 1, H, hd).astype(q.dtype)
 
 
 def attention_xla(

@@ -11,7 +11,7 @@ import pytest
 from rag_llm_k8s_tpu.core.config import DTypePolicy, EngineConfig, LlamaConfig, SamplingConfig
 from rag_llm_k8s_tpu.engine.engine import InferenceEngine
 from rag_llm_k8s_tpu.engine.sampling import sample_token, top_p_filter
-from rag_llm_k8s_tpu.models.llama import LlamaModel, causal_bias, init_llama_params, make_kv_cache
+from rag_llm_k8s_tpu.models.llama import LlamaModel, init_llama_params, make_kv_cache
 
 FP32 = DTypePolicy.fp32()
 GREEDY = SamplingConfig(do_sample=False, max_new_tokens=8)
@@ -35,10 +35,10 @@ def naive_greedy(cfg, params, prompt, n_steps):
     for _ in range(n_steps):
         S = len(seq)
         cache = make_kv_cache(cfg, 1, S, jnp.float32)
-        bias = causal_bias(jnp.ones((1, S), jnp.int32), S)
+        window = jnp.zeros((1,), jnp.int32), jnp.full((1,), S, jnp.int32)
         pos = jnp.arange(S)[None, :]
         logits, _ = model.apply(
-            {"params": params}, jnp.asarray([seq], jnp.int32), pos, cache, bias, jnp.int32(0)
+            {"params": params}, jnp.asarray([seq], jnp.int32), pos, cache, *window, jnp.int32(0)
         )
         nxt = int(jnp.argmax(logits[0, -1]))
         if nxt in cfg.eos_token_ids:

@@ -15,15 +15,19 @@ from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig, RopeScalingCon
 from rag_llm_k8s_tpu.models.llama import (
     KVCache,
     LlamaModel,
-    causal_bias,
-    decode_bias,
     init_llama_params,
     make_kv_cache,
+    mask_window,
     rope_frequencies,
 )
 from rag_llm_k8s_tpu.models.loader import convert_hf_state_dict
 
 FP32 = DTypePolicy.fp32()
+
+
+def _window(B, S, start=0):
+    """(kv_start, kv_len) vectors for a full [start, S) valid window."""
+    return jnp.full((B,), start, jnp.int32), jnp.full((B,), S, jnp.int32)
 
 
 @pytest.fixture(scope="module")
@@ -40,11 +44,12 @@ class TestForward:
         cache = make_kv_cache(cfg, B, S, jnp.float32)
         tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, cfg.vocab_size)
         pos = jnp.broadcast_to(jnp.arange(S), (B, S))
-        bias = causal_bias(jnp.ones((B, S), jnp.int32), S)
-        logits, new_cache = model.apply({"params": params}, tokens, pos, cache, bias, jnp.int32(0))
+        logits, new_cache = model.apply(
+            {"params": params}, tokens, pos, cache, *_window(B, S), jnp.int32(0)
+        )
         assert logits.shape == (B, S, cfg.vocab_size)
         assert logits.dtype == jnp.float32
-        assert new_cache.k.shape == (cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim)
+        assert new_cache.k.shape == (cfg.num_layers, B, cfg.num_kv_heads, S, cfg.head_dim)
 
     def test_causality(self, tiny):
         """Changing a future token must not change past logits."""
@@ -52,11 +57,10 @@ class TestForward:
         B, S = 1, 8
         cache = make_kv_cache(cfg, B, S, jnp.float32)
         pos = jnp.broadcast_to(jnp.arange(S), (B, S))
-        bias = causal_bias(jnp.ones((B, S), jnp.int32), S)
         t1 = jnp.array([[5, 6, 7, 8, 9, 10, 11, 12]], jnp.int32)
         t2 = t1.at[0, -1].set(99)
-        l1, _ = model.apply({"params": params}, t1, pos, cache, bias, jnp.int32(0))
-        l2, _ = model.apply({"params": params}, t2, pos, cache, bias, jnp.int32(0))
+        l1, _ = model.apply({"params": params}, t1, pos, cache, *_window(B, S), jnp.int32(0))
+        l2, _ = model.apply({"params": params}, t2, pos, cache, *_window(B, S), jnp.int32(0))
         np.testing.assert_allclose(l1[:, :-1], l2[:, :-1], atol=1e-5)
         assert not np.allclose(l1[:, -1], l2[:, -1])
 
@@ -70,27 +74,25 @@ class TestForward:
 
         # full forward
         cache = make_kv_cache(cfg, B, S, jnp.float32)
-        bias = causal_bias(jnp.ones((B, S), jnp.int32), S)
-        full_logits, _ = model.apply({"params": params}, tokens, pos, cache, bias, jnp.int32(0))
+        full_logits, _ = model.apply(
+            {"params": params}, tokens, pos, cache, *_window(B, S), jnp.int32(0)
+        )
 
         # prefill 6, then decode 4 one at a time
         P = 6
         cache = make_kv_cache(cfg, B, S, jnp.float32)
-        pbias = causal_bias(jnp.ones((B, P), jnp.int32), S)
         plogits, cache = model.apply(
-            {"params": params}, tokens[:, :P], pos[:, :P], cache, pbias, jnp.int32(0)
+            {"params": params}, tokens[:, :P], pos[:, :P], cache, *_window(B, P), jnp.int32(0)
         )
         np.testing.assert_allclose(plogits, full_logits[:, :P], rtol=2e-4, atol=2e-4)
 
         for t in range(P, S):
-            valid = jnp.arange(S)[None, :] <= t
-            dbias = decode_bias(valid)
             dlogits, cache = model.apply(
                 {"params": params},
                 tokens[:, t : t + 1],
                 pos[:, t : t + 1],
                 cache,
-                dbias,
+                *_window(B, t + 1),
                 jnp.int32(t),
             )
             np.testing.assert_allclose(
@@ -107,9 +109,10 @@ class TestForward:
 
         # unpadded
         cache = make_kv_cache(cfg, 1, T, jnp.float32)
-        bias = causal_bias(jnp.ones((1, S), jnp.int32), T)
         pos = jnp.arange(S)[None, :]
-        l_ref, _ = model.apply({"params": params}, tokens, pos, cache, bias, jnp.int32(0))
+        l_ref, _ = model.apply(
+            {"params": params}, tokens, pos, cache, *_window(1, S), jnp.int32(0)
+        )
 
         # left-padded by PAD zeros
         padded = jnp.concatenate([jnp.zeros((1, PAD), jnp.int32), tokens], axis=1)
@@ -117,9 +120,11 @@ class TestForward:
             [jnp.zeros((1, PAD), jnp.int32), jnp.ones((1, S), jnp.int32)], axis=1
         )
         cache = make_kv_cache(cfg, 1, T, jnp.float32)
-        bias_p = causal_bias(pad_mask, T)
+        kv_start, kv_len = mask_window(pad_mask)
         pos_p = jnp.concatenate([jnp.zeros((1, PAD), jnp.int32), pos], axis=1)
-        l_pad, _ = model.apply({"params": params}, padded, pos_p, cache, bias_p, jnp.int32(0))
+        l_pad, _ = model.apply(
+            {"params": params}, padded, pos_p, cache, kv_start, kv_len, jnp.int32(0)
+        )
         np.testing.assert_allclose(l_pad[:, -1], l_ref[:, -1], rtol=2e-4, atol=2e-4)
 
 
@@ -204,10 +209,9 @@ class TestHFParity:
 
         model = LlamaModel(cfg, FP32)
         cache = make_kv_cache(cfg, B, S, jnp.float32)
-        bias = causal_bias(jnp.ones((B, S), jnp.int32), S)
         pos = jnp.broadcast_to(jnp.arange(S), (B, S))
         logits, _ = model.apply(
-            {"params": params}, jnp.asarray(tokens_np), pos, cache, bias, jnp.int32(0)
+            {"params": params}, jnp.asarray(tokens_np), pos, cache, *_window(B, S), jnp.int32(0)
         )
         np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=1e-3, atol=1e-3)
 
