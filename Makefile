@@ -1,0 +1,17 @@
+# Test lanes:
+#   make test      - main suite on an 8-virtual-device CPU platform (mesh/
+#                    sharding coverage without hardware)
+#   make tpu-test  - hardware lane on the real TPU chip (kernels vs oracles,
+#                    engine end-to-end); skips itself when no TPU is present
+#   make bench     - headline benchmark JSON line (real chip)
+
+test:
+	python -m pytest tests/ -q
+
+tpu-test:
+	python -m pytest tests_tpu/ -q
+
+bench:
+	python bench.py
+
+.PHONY: test tpu-test bench

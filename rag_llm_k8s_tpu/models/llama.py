@@ -169,6 +169,11 @@ class Attention(nn.Module):
     mesh: Optional[Mesh] = None  # enables shard_map-over-heads TP for kernels
 
     def _resolved_impl(self) -> str:
+        if self.attn_impl not in ("auto", "pallas", "pallas_interpret", "xla"):
+            raise ValueError(
+                f"attn_impl={self.attn_impl!r}: expected one of "
+                "'auto', 'pallas', 'pallas_interpret', 'xla'"
+            )
         if self.attn_impl == "auto":
             return "pallas" if jax.default_backend() == "tpu" else "xla"
         return self.attn_impl
@@ -277,12 +282,18 @@ class Attention(nn.Module):
             # prefill/training writes at slot 0, so the fresh K/V ARE the
             # populated cache prefix — attend over S keys, not T cache slots.
             # Chunked prefill (S > 1 at write_index > 0) is NOT supported by
-            # this path; fail loudly when the index is concrete.
-            if not isinstance(write_index, jax.core.Tracer):
-                assert int(write_index) == 0, (
-                    "multi-token calls must write at slot 0 (chunked prefill "
-                    "at write_index > 0 would need cache-wide attention)"
+            # this path; a traced index can't be checked, so it is rejected
+            # outright rather than risking silently-wrong attention.
+            if isinstance(write_index, jax.core.Tracer):
+                raise ValueError(
+                    "multi-token calls require a CONCRETE write_index == 0 "
+                    "(chunked prefill at write_index > 0 would need "
+                    "cache-wide attention, which this path does not do)"
                 )
+            assert int(write_index) == 0, (
+                "multi-token calls must write at slot 0 (chunked prefill "
+                "at write_index > 0 would need cache-wide attention)"
+            )
             out = self._attend(q, k, v, kv_start, kv_len, layer, decode=False)
         out = out.astype(dt.compute_dtype).reshape(B, S, H * hd)
         return dense(D, "wo")(out), (k_cache, v_cache)

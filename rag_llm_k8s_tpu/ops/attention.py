@@ -78,6 +78,13 @@ def _flash_kernel(
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
+        # zero K/V rows outside the valid window BEFORE any matmul: cache
+        # slots past the frontier may be uninitialized device memory, and a
+        # NaN there survives even a zero-weight product (0 * NaN = NaN)
+        cpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, 1), 0)
+        cok = (cpos >= kv_start_ref[b]) & (cpos < kv_len_ref[b])
+        k = jnp.where(cok, k, 0)
+        v = jnp.where(cok, v, 0)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
@@ -216,6 +223,15 @@ def _decode_kernel(
         q = q_ref[0]  # [K, G, hd]
         k = k_ref[0, 0]  # [K, bk, hd]
         v = v_ref[0, 0]
+        # zero K/V rows outside the valid window BEFORE any matmul: cache
+        # slots past the frontier may be uninitialized device memory, and a
+        # NaN there survives even a zero-weight product (0 * NaN = NaN)
+        rpos = blk_lo + jax.lax.broadcasted_iota(
+            jnp.int32, (k.shape[0], k.shape[1], 1), 1
+        )
+        rok = (rpos >= kv_start_ref[b]) & (rpos < kv_len_ref[b])
+        k = jnp.where(rok, k, 0)
+        v = jnp.where(rok, v, 0)
         # one batched dot over all kv heads: [K, G, hd] x [K, bk, hd] -> [K, G, bk]
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
@@ -244,13 +260,16 @@ def _decode_kernel(
 
 def _decode_block(T: int, bk: int) -> int:
     """Largest K/V block ≤ ``bk`` that tiles ``T`` exactly (T is a multiple of
-    128 by engine construction; tiny tests may pass smaller T = single block)."""
+    128 by engine construction; a small T ≤ bk runs as a single block)."""
     if T <= bk:
         return T
     for cand in (512, 384, 256, 128):
         if cand <= bk and T % cand == 0:
             return cand
-    return T  # single block fallback (T < 128)
+    raise ValueError(
+        f"cache length T={T} does not tile into blocks ≤ bk={bk}: pad T to a "
+        "multiple of 128 (the engine rounds cache lengths for this)"
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("bk", "interpret"))

@@ -1,0 +1,18 @@
+"""Hardware test lane: runs on the REAL TPU chip (no platform forcing).
+
+The main suite (`tests/`) pins an 8-virtual-device CPU platform for
+mesh/sharding coverage; this lane is the complement — it executes the Pallas
+kernels and the engine on actual hardware so on-chip correctness is a
+repeatable artifact, not a commit-message claim. Run via ``make tpu-test``
+or ``python -m pytest tests_tpu/ -q`` (skips itself entirely off-TPU).
+"""
+
+import jax
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    if jax.default_backend() != "tpu":
+        skip = pytest.mark.skip(reason=f"needs TPU (backend={jax.default_backend()})")
+        for item in items:
+            item.add_marker(skip)

@@ -1,0 +1,148 @@
+"""On-chip correctness: Pallas kernels vs XLA oracles, engine end-to-end.
+
+These are the hardware counterparts of the interpret-mode tests in
+``tests/`` — same oracles, real Mosaic compilation, real MXU/VPU numerics.
+Parity checks run under ``jax.default_matmul_precision("highest")`` so both
+sides accumulate in true fp32 (at default precision the MXU rounds inputs
+to bf16 and the two implementations differ by rounding noise, not bugs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rag_llm_k8s_tpu.core.config import (
+    DTypePolicy,
+    EngineConfig,
+    LlamaConfig,
+    SamplingConfig,
+)
+
+
+class TestKnnKernel:
+    def test_matches_oracle(self):
+        from rag_llm_k8s_tpu.ops.knn import knn_topk_pallas, knn_topk_xla
+
+        rng = np.random.RandomState(0)
+        N, D, Q, k = 2048, 1024, 4, 5
+        emb = jnp.asarray(rng.randn(N, D).astype(np.float32))
+        emb = emb / jnp.linalg.norm(emb, axis=1, keepdims=True)
+        queries = emb[:Q] + 0.01 * jnp.asarray(rng.randn(Q, D).astype(np.float32))
+        norms = jnp.sum(emb * emb, axis=1)[None, :]
+
+        with jax.default_matmul_precision("highest"):
+            v_got, i_got = knn_topk_pallas(queries, emb, norms, k=k)
+            v_ref, i_ref = knn_topk_xla(queries, emb, norms, k=k)
+        np.testing.assert_array_equal(np.asarray(i_got), np.asarray(i_ref))
+        np.testing.assert_allclose(np.asarray(v_got), np.asarray(v_ref), rtol=1e-4, atol=1e-5)
+
+
+class TestAttentionKernels:
+    def test_flash_prefill_matches_oracle(self):
+        from rag_llm_k8s_tpu.ops.attention import attention_xla, flash_attention
+
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        B, S, H, K, hd = 2, 512, 8, 2, 128
+        q = jax.random.normal(ks[0], (B, S, H, hd), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, K, hd), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, K, hd), jnp.float32)
+        kv_start = jnp.array([0, 100], jnp.int32)
+        with jax.default_matmul_precision("highest"):
+            got = flash_attention(q, k, v, kv_start=kv_start, causal=True)
+            want = attention_xla(q, k, v, kv_start=kv_start, causal=True)
+        valid = (jnp.arange(S)[None, :] >= kv_start[:, None])[:, :, None, None]
+        np.testing.assert_allclose(
+            np.asarray(jnp.where(valid, got, 0)),
+            np.asarray(jnp.where(valid, want, 0)),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    def test_decode_matches_oracle(self):
+        from rag_llm_k8s_tpu.ops.attention import decode_attention, decode_attention_xla
+
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        L, B, H, K, T, hd = 2, 4, 8, 2, 640, 128
+        q = jax.random.normal(ks[0], (B, 1, H, hd), jnp.float32)
+        kc = jax.random.normal(ks[1], (L, B, K, T, hd), jnp.float32)
+        vc = jax.random.normal(ks[2], (L, B, K, T, hd), jnp.float32)
+        kv_start = jnp.array([0, 17, 300, 0], jnp.int32)
+        kv_len = jnp.array([T, 400, 301, 128], jnp.int32)
+        for lay in range(L):
+            with jax.default_matmul_precision("highest"):
+                got = decode_attention(q, kc, vc, kv_start, kv_len, jnp.int32(lay))
+                want = decode_attention_xla(q, kc, vc, kv_start, kv_len, jnp.int32(lay))
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+            )
+
+
+class TestEngineOnChip:
+    def test_generate_pallas_vs_xla_logits_path(self):
+        """Full model prefill + one decode step, Pallas vs XLA oracle, at a
+        real (1B-proxy) layer shape."""
+        from rag_llm_k8s_tpu.models.llama import (
+            LlamaModel,
+            init_llama_params,
+            make_kv_cache,
+            mask_window,
+        )
+
+        fp32 = DTypePolicy.fp32()
+        cfg = LlamaConfig.llama_3_2_1b()
+        cfg = type(cfg)(**{**cfg.__dict__, "num_layers": 2, "vocab_size": 2048})
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, fp32)
+        B, S, T = 2, 256, 384
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 3, cfg.vocab_size)
+        pad_mask = jnp.ones((B, S), jnp.int32).at[1, :100].set(0)
+        kv_start, _ = mask_window(pad_mask)
+        kv_len = jnp.full((B,), S, jnp.int32)
+        pos = jnp.clip(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
+        real_len = jnp.sum(pad_mask, axis=-1)
+
+        def run(impl):
+            with jax.default_matmul_precision("highest"):
+                model = LlamaModel(cfg, fp32, attn_impl=impl)
+                cache = make_kv_cache(cfg, B, T, jnp.float32)
+                plog, cache = jax.jit(
+                    lambda p, t: model.apply(
+                        {"params": p}, t, pos, cache, kv_start, kv_len, jnp.int32(0)
+                    )
+                )(params, tokens)
+                dlog, _ = jax.jit(
+                    lambda p, t, c: model.apply(
+                        {"params": p}, t, real_len[:, None].astype(jnp.int32), c,
+                        kv_start, jnp.full((B,), S + 1, jnp.int32), jnp.int32(S),
+                    )
+                )(params, tokens[:, -1:], cache)
+            return np.asarray(plog), np.asarray(dlog)
+
+        p_ref, d_ref = run("xla")
+        p_got, d_got = run("pallas")
+        valid = np.asarray(pad_mask).astype(bool)[:, :, None]
+        np.testing.assert_allclose(
+            np.where(valid, p_got, 0), np.where(valid, p_ref, 0), rtol=1e-4, atol=1e-4
+        )
+        np.testing.assert_allclose(d_got, d_ref, rtol=1e-4, atol=1e-4)
+
+    def test_engine_generate_smoke(self):
+        """The real serving engine generates on hardware through the Pallas
+        path: deterministic greedy, correct lengths, EOS-free tail."""
+        from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+        from rag_llm_k8s_tpu.models.llama import init_llama_params
+
+        cfg = LlamaConfig.tiny(vocab_size=512)
+        cfg = type(cfg)(**{**cfg.__dict__, "num_heads": 8, "num_kv_heads": 8, "head_dim": 64})
+        dtypes = DTypePolicy()
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, dtypes)
+        eng = InferenceEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=8),
+            engine_config=EngineConfig(prompt_buckets=(16,), max_batch_size=4),
+            dtypes=dtypes,
+        )
+        prompts = [[3, 5, 7], [11, 13, 17, 19, 23]]
+        out1 = eng.generate(prompts)
+        out2 = eng.generate(prompts)
+        assert out1 == out2  # greedy determinism through the kernel path
+        assert all(len(o) <= 8 for o in out1)
+        assert all(t not in cfg.eos_token_ids for o in out1 for t in o)
