@@ -4,6 +4,8 @@
 #   make tpu-test  - hardware lane on the real TPU chip (kernels vs oracles,
 #                    engine end-to-end); skips itself when no TPU is present
 #   make bench     - headline benchmark JSON line (real chip)
+#   make check     - THE pre-snapshot gate: everything the driver measures.
+#                    Run before every snapshot commit; nothing ships red.
 
 test:
 	python -m pytest tests/ -q
@@ -14,4 +16,9 @@ tpu-test:
 bench:
 	python bench.py
 
-.PHONY: test tpu-test bench
+check: test tpu-test bench
+	python -c "from __graft_entry__ import entry; import jax; fn, a = entry(); jax.jit(fn).lower(*a).compile(); print('entry: compile OK')"
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+		python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8); print('dryrun_multichip(8): OK')"
+
+.PHONY: test tpu-test bench check

@@ -102,10 +102,10 @@ class InferenceEngine:
         cfg, dt, sampling = self.config, self.dtypes, self.sampling
         model = self.model
         # cache length rounds up to a 128 multiple so the fused decode kernel
-        # tiles it exactly; slots past S + max_new never enter any kv window
-        T = S + max_new
-        if T > 128:
-            T = -(-T // 128) * 128
+        # tiles it exactly AND the bf16 [.., T, hd] blocks meet Mosaic's
+        # second-to-minor tile height even for tiny buckets; slots past
+        # S + max_new never enter any kv window
+        T = -(-(S + max_new) // 128) * 128
         eos_ids = cfg.eos_token_ids
         cache_dtype = dt.compute_dtype
         pad_id = self.pad_id

@@ -184,6 +184,20 @@ class Attention(nn.Module):
         per-layer slice is ever materialized), otherwise fresh
         ``[B, S, K, hd]``."""
         impl = self._resolved_impl()
+        mesh = self.mesh
+        # kv heads sit at dim 2 in both layouts ([L,B,K,T,hd] / [B,S,K,hd])
+        H, K = q.shape[2], k.shape[2]
+        tp = (
+            mesh.shape["tp"]
+            if mesh is not None and "tp" in mesh.axis_names
+            else 1
+        )
+        heads_shardable = tp > 1 and H % tp == 0 and K % tp == 0
+        if impl != "xla" and tp > 1 and not heads_shardable:
+            # head counts don't tile the tp axis: an unsharded Pallas call
+            # inside the mesh program would force a per-layer full-cache
+            # gather — the sharding-transparent XLA path is strictly better
+            impl = "xla"
         if impl == "xla":
             if decode:
                 return decode_attention_xla(q, k, v, kv_start, kv_len, layer)
@@ -199,16 +213,7 @@ class Attention(nn.Module):
                 q_, k_, v_, s_, l_, causal=True, interpret=interpret
             )
 
-        mesh = self.mesh
-        # kv heads sit at dim 2 in both layouts ([L,B,K,T,hd] / [B,S,K,hd])
-        H, K = q.shape[2], k.shape[2]
-        if (
-            mesh is not None
-            and "tp" in mesh.axis_names
-            and mesh.shape["tp"] > 1
-            and H % mesh.shape["tp"] == 0
-            and K % mesh.shape["tp"] == 0
-        ):
+        if heads_shardable:
             # heads are independent: shard the kernel over the tp axis, one
             # per-device Pallas call each on its local heads — no collectives
             from jax.experimental.shard_map import shard_map
@@ -282,18 +287,15 @@ class Attention(nn.Module):
             # prefill/training writes at slot 0, so the fresh K/V ARE the
             # populated cache prefix — attend over S keys, not T cache slots.
             # Chunked prefill (S > 1 at write_index > 0) is NOT supported by
-            # this path; a traced index can't be checked, so it is rejected
-            # outright rather than risking silently-wrong attention.
-            if isinstance(write_index, jax.core.Tracer):
-                raise ValueError(
-                    "multi-token calls require a CONCRETE write_index == 0 "
-                    "(chunked prefill at write_index > 0 would need "
-                    "cache-wide attention, which this path does not do)"
+            # this path. The check is concrete-only: under tracing (nn.scan
+            # broadcasts every argument as a tracer, as do init/eval_shape/
+            # grad) the value can't be inspected, and every in-tree caller
+            # passes 0 for multi-token calls.
+            if not isinstance(write_index, jax.core.Tracer):
+                assert int(write_index) == 0, (
+                    "multi-token calls must write at slot 0 (chunked prefill "
+                    "at write_index > 0 would need cache-wide attention)"
                 )
-            assert int(write_index) == 0, (
-                "multi-token calls must write at slot 0 (chunked prefill "
-                "at write_index > 0 would need cache-wide attention)"
-            )
             out = self._attend(q, k, v, kv_start, kv_len, layer, decode=False)
         out = out.astype(dt.compute_dtype).reshape(B, S, H * hd)
         return dense(D, "wo")(out), (k_cache, v_cache)

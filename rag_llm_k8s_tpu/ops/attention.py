@@ -259,17 +259,15 @@ def _decode_kernel(
 
 
 def _decode_block(T: int, bk: int) -> int:
-    """Largest K/V block ≤ ``bk`` that tiles ``T`` exactly (T is a multiple of
-    128 by engine construction; a small T ≤ bk runs as a single block)."""
+    """Largest K/V block ≤ ``bk`` that tiles ``T`` exactly: prefer the coarse
+    candidates (more MXU work per sequential grid step), else the largest
+    divisor of ``T`` that fits — any caller-supplied ``bk`` works."""
     if T <= bk:
         return T
     for cand in (512, 384, 256, 128):
         if cand <= bk and T % cand == 0:
             return cand
-    raise ValueError(
-        f"cache length T={T} does not tile into blocks ≤ bk={bk}: pad T to a "
-        "multiple of 128 (the engine rounds cache lengths for this)"
-    )
+    return max(d for d in range(1, min(bk, T) + 1) if T % d == 0)
 
 
 @functools.partial(jax.jit, static_argnames=("bk", "interpret"))
@@ -301,8 +299,16 @@ def decode_attention(
     assert S == 1, f"decode_attention is single-token (got S={S})"
     L, _, K, T, _ = k_cache.shape
     G = H // K
+    req_bk = bk
     bk = _decode_block(T, bk)
     assert T % bk == 0, (T, bk)
+    if not interpret and bk % 16:
+        # a (bk, hd) block's second-to-minor dim must meet Mosaic's 16-row
+        # bf16 tile on real hardware; fail actionably instead of opaquely
+        raise ValueError(
+            f"cache length T={T} only tiles into blocks of {bk} ≤ bk={req_bk}: "
+            "pad T to a multiple of 128 — the engine rounds cache lengths for this"
+        )
 
     qh = q.reshape(B, K, G, hd)
     grid = (B, T // bk)
