@@ -1,32 +1,204 @@
 #!/usr/bin/env python
-"""Headline benchmark: decode throughput, tokens/sec/chip.
+"""Headline benchmark: decode throughput + end-to-end /query latency.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", plus the
+north-star fields "query_p50_ms"/"query_p95_ms"/"query_stage_ms"}.
 
-What runs: the framework's real serving path (bucketed prefill + while-loop
-decode, greedy) on Llama-3.2-1B in bf16 — the largest Llama family member
-that fits a single v5e chip (the 8B flagship runs the identical executable
-TP-sharded over a slice; no multi-chip hardware is available here). Weights
-are zero-materialized: decode cost is shape/dtype-bound, not value-bound.
+What runs:
+1. Decode throughput — the framework's real serving path (bucketed prefill +
+   while-loop decode, greedy) on Llama-3.2-1B in bf16, the largest Llama
+   family member that fits a single v5e chip (the 8B flagship runs the
+   identical executable TP-sharded over a slice; no multi-chip hardware is
+   available here). Weights are zero-materialized: decode cost is
+   shape/dtype-bound, not value-bound.
+2. North-star /query p50 (BASELINE.md: p50 < 2 s) — the reference's whole
+   serving chain (/root/reference/llm/rag.py:146-181): the bundled
+   Technology Radar PDF is ingested through the real WSGI app
+   (PDF parse → chunk → bge-m3-shaped batch embed → index), then ≥20
+   queries run embed → kNN → prefill → 150-token sampled decode on-chip
+   with the reference's exact generation budget (rag.py:172) and retrieval
+   shape (rag.py:39,114,164). Latency is wall-clock at the HTTP client.
 
 Baseline: the reference serves generation through HF ``transformers``
 ``model.generate`` on CPU (/root/reference/llm/rag.py:172, fp32). The SAME
 architecture is measured through that exact stack (torch CPU, random init)
 and cached in BENCH_BASELINE.json — "CPU baseline tokens/sec" per
 BASELINE.md, measured not cited. vs_baseline = TPU tok/s / CPU tok/s (both
-single-chip/single-node).
+single-chip/single-node). The p50 target is absolute (< 2000 ms).
 """
 
+import io
 import json
 import os
 import time
+import zlib
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINE_FILE = os.path.join(REPO, "BENCH_BASELINE.json")
+CORPUS_PDF = "/root/reference/tr_technology_radar_vol_29_en.pdf"
 
 PROMPT_LEN = 128
 NEW_TOKENS = 128
 BATCH = 8
+
+QUERIES = [
+    "What does the Radar say about large language models?",
+    "How should teams approach platform engineering?",
+    "What is the guidance on infrastructure as code?",
+    "Which techniques are recommended for data mesh adoption?",
+    "What does the Radar advise about dependency health checks?",
+    "How are AI-assisted coding tools assessed?",
+    "What tools are highlighted for observability?",
+    "What is the position on micro frontends?",
+    "How should organizations handle legacy system displacement?",
+    "What does the Radar say about supply chain security?",
+    "Which cloud platforms or services are featured?",
+    "What testing practices does the Radar recommend?",
+    "How is developer experience discussed?",
+    "What are the recommendations around API design?",
+    "What does the Radar say about vector databases?",
+    "Which languages and frameworks moved rings this volume?",
+    "What is the advice on continuous deployment pipelines?",
+    "How should teams evaluate low-code platforms?",
+    "What security techniques does the Radar highlight?",
+    "What does the Radar conclude about remote team practices?",
+]
+
+
+class WordHashTokenizer:
+    """Deterministic stand-in tokenizer with realistic fertility (~1.3
+    tokens per English word — the measured Llama-3 rate on prose). The real
+    ``tokenizer.json`` files cannot be fetched here (zero egress);
+    tokenization cost is negligible next to embed/prefill/decode, so e2e
+    timings stay honest as long as token COUNTS are realistic."""
+
+    def __init__(self, vocab_size: int, bos: int = 0):
+        self.vocab_size = vocab_size
+        self.bos = bos
+
+    def encode(self, text: str):
+        ids = []
+        for w in text.split():
+            h = zlib.crc32(w.encode("utf-8"))
+            # ~4.5 chars/token: a 1-4 char word is 1 token, 5-9 is 2, ...
+            for j in range(max(1, (len(w) + 4) // 5)):
+                ids.append(100 + (h + j * 2654435761) % (self.vocab_size - 200))
+        return ids
+
+    def decode(self, ids, skip_special_tokens=True):
+        return " ".join(f"tok{int(i)}" for i in ids)
+
+
+def _synthetic_pdf(n_words: int = 4000) -> bytes:
+    """Fallback corpus when the bundled Technology Radar PDF is absent."""
+    words = [f"radar technique tool platform trial assess hold adopt item{i}" for i in range(n_words // 9)]
+    content = ("BT /F1 12 Tf (" + " ".join(words) + ") Tj ET").encode()
+    return b"".join(
+        [
+            b"%PDF-1.4\n",
+            b"1 0 obj << /Type /Catalog /Pages 2 0 R >> endobj\n",
+            b"2 0 obj << /Type /Pages /Kids [3 0 R] /Count 1 >> endobj\n",
+            b"3 0 obj << /Type /Page /Parent 2 0 R /Contents 4 0 R "
+            b"/Resources << /Font << /F1 5 0 R >> >> >> endobj\n",
+            b"4 0 obj << /Length %d >> stream\n%s\nendstream endobj\n" % (len(content), content),
+            b"5 0 obj << /Type /Font /Subtype /Type1 /BaseFont /Helvetica >> endobj\n",
+            b"%%EOF",
+        ]
+    )
+
+
+def measure_query_e2e() -> dict:
+    """North-star: end-to-end /query latency through the real WSGI app."""
+    import jax
+    import jax.numpy as jnp
+
+    from rag_llm_k8s_tpu.core.config import (
+        AppConfig,
+        DTypePolicy,
+        EncoderConfig,
+        EngineConfig,
+        LlamaConfig,
+        SamplingConfig,
+    )
+    from rag_llm_k8s_tpu.engine.encoder import EncoderRunner
+    from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+    from rag_llm_k8s_tpu.index.store import VectorStore
+    from rag_llm_k8s_tpu.models.bge_m3 import init_encoder_params
+    from rag_llm_k8s_tpu.models.llama import init_llama_params
+    from rag_llm_k8s_tpu.server.app import RagService, create_app
+
+    def zeros_like_tree(shapes):
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    dtypes = DTypePolicy()
+    llama_cfg = LlamaConfig.llama_3_2_1b()
+    enc_cfg = EncoderConfig.bge_m3()
+    app_cfg = AppConfig(model=llama_cfg, encoder=enc_cfg)
+
+    # one 4096 bucket: the reference's full 3×1000-word context (~4k tokens)
+    # fits without shrinking, so the measured prefill is the real RAG prompt
+    engine = InferenceEngine(
+        llama_cfg,
+        zeros_like_tree(
+            jax.eval_shape(lambda: init_llama_params(jax.random.PRNGKey(0), llama_cfg, dtypes))
+        ),
+        sampling=SamplingConfig(),  # reference parity: 150 new, 0.7/0.9 sampled
+        engine_config=EngineConfig(prompt_buckets=(4096,), max_batch_size=4),
+        dtypes=dtypes,
+    )
+    encoder = EncoderRunner(
+        enc_cfg,
+        zeros_like_tree(
+            jax.eval_shape(lambda: init_encoder_params(jax.random.PRNGKey(1), enc_cfg, dtypes))
+        ),
+        dtypes=dtypes,
+        length_buckets=(128, 2048),  # queries hit 128; 1000-word chunks hit 2048
+        max_batch=8,
+    )
+    store = VectorStore(dim=enc_cfg.embed_dim)
+    tok = WordHashTokenizer(llama_cfg.vocab_size, bos=llama_cfg.bos_token_id)
+    enc_tok = WordHashTokenizer(enc_cfg.vocab_size)
+    service = RagService(app_cfg, engine, tok, encoder, enc_tok, store)
+    service.warmup()
+    client = create_app(service).test_client()
+
+    if os.path.exists(CORPUS_PDF):
+        with open(CORPUS_PDF, "rb") as f:
+            pdf_bytes = f.read()
+    else:
+        pdf_bytes = _synthetic_pdf()
+    t0 = time.monotonic()
+    r = client.post(
+        "/upload_pdf",
+        data={"file": (io.BytesIO(pdf_bytes), "corpus.pdf")},
+        content_type="multipart/form-data",
+    )
+    assert r.status_code == 200, r.get_data()
+    ingest_s = time.monotonic() - t0
+
+    client.post("/query", json={"prompt": QUERIES[0]})  # warm the query path end to end
+    lat_ms, stages = [], {"embed_ms": [], "retrieve_ms": [], "generate_ms": []}
+    for q in QUERIES:
+        t0 = time.monotonic()
+        r = client.post("/query", json={"prompt": q})
+        lat_ms.append((time.monotonic() - t0) * 1e3)
+        body = r.get_json()
+        assert r.status_code == 200 and "generated_text" in body, body
+        for k in stages:
+            stages[k].append(body["timings"][k])
+
+    lat_ms.sort()
+    n = len(lat_ms)
+    return {
+        "query_p50_ms": round(lat_ms[n // 2], 1),
+        "query_p95_ms": round(lat_ms[min(n - 1, int(n * 0.95))], 1),
+        "query_stage_ms": {
+            k.removesuffix("_ms"): round(sum(v) / len(v), 1) for k, v in stages.items()
+        },
+        "query_n": n,
+        "ingest_s": round(ingest_s, 1),
+        "index_vectors": store.ntotal,
+    }
 
 
 def measure_tpu() -> float:
@@ -126,16 +298,16 @@ def get_cpu_baseline() -> float:
 def main():
     baseline = get_cpu_baseline()
     tpu_tps = measure_tpu()
-    print(
-        json.dumps(
-            {
-                "metric": "llama_1b_decode_throughput",
-                "value": round(tpu_tps, 1),
-                "unit": "tokens/sec/chip",
-                "vs_baseline": round(tpu_tps / baseline, 1),
-            }
-        )
-    )
+    e2e = measure_query_e2e()
+    line = {
+        "metric": "llama_1b_decode_throughput",
+        "value": round(tpu_tps, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tpu_tps / baseline, 1),
+        "query_p50_target_ms": 2000,  # BASELINE.md north star: p50 < 2 s
+    }
+    line.update(e2e)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":
