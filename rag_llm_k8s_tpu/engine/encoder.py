@@ -30,11 +30,15 @@ class EncoderRunner:
         mesh: Optional[MeshContext] = None,
         length_buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048, 4096, 8192),
         max_batch: int = 16,
+        eos_id: Optional[int] = None,
     ):
         self.config = config
         self.params = params
         self.dtypes = dtypes
         self.mesh = mesh
+        # when set, sequences clamped to the largest bucket keep a trailing
+        # EOS — bge-m3's CLS pooling is trained on </s>-terminated input
+        self.eos_id = eos_id
         self.length_buckets = tuple(
             b for b in length_buckets if b <= config.max_encode_len
         ) or (config.max_encode_len,)
@@ -61,7 +65,11 @@ class EncoderRunner:
             tokens = np.full((B, S), pad, np.int32)
             mask = np.zeros((B, S), np.int32)
             for row, i in enumerate(group):
-                ids = list(token_lists[i])[: S]
+                ids = list(token_lists[i])
+                if len(ids) > S:
+                    ids = ids[:S]
+                    if self.eos_id is not None:
+                        ids[-1] = self.eos_id
                 tokens[row, : len(ids)] = ids
                 mask[row, : len(ids)] = 1
             emb = self._jit(self.params, jnp.asarray(tokens), jnp.asarray(mask))

@@ -230,11 +230,26 @@ class InferenceEngine:
 
         cap = self.engine_config.max_batch_size
         if len(prompts) > cap:
+            # one base key, folded per sub-batch: a pinned seed stays
+            # reproducible without every sub-batch sampling identically
+            base = self._next_rng(seed)
             out: List[List[int]] = []
-            for i in range(0, len(prompts), cap):
-                out.extend(self.generate(prompts[i : i + cap], max_new_tokens=max_new, seed=seed))
+            for sub, i in enumerate(range(0, len(prompts), cap)):
+                out.extend(
+                    self._generate_batch(
+                        prompts[i : i + cap], max_new, jax.random.fold_in(base, sub)
+                    )
+                )
             return out
+        return self._generate_batch(prompts, max_new, self._next_rng(seed))
 
+    def _generate_batch(
+        self,
+        prompts: Sequence[Sequence[int]],
+        max_new: int,
+        rng: jax.Array,
+    ) -> List[List[int]]:
+        """One device call for <= max_batch_size prompts with a decided rng."""
         S = self._bucket_len(max(len(p) for p in prompts))
         B = self._bucket_batch(len(prompts))
         max_new = self._clamp_max_new(S, max_new)
@@ -251,7 +266,6 @@ class InferenceEngine:
             pad_mask[i, -1] = 1
 
         fn = self._get_compiled(B, S, max_new)
-        rng = self._next_rng(seed)
         tokens_j, mask_j, rng_j = self._place_inputs(tokens, pad_mask, rng)
         out = np.asarray(fn(self.params, tokens_j, mask_j, rng_j))
 

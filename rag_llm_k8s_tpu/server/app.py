@@ -89,7 +89,17 @@ class RagService:
     # -- embedding ------------------------------------------------------
     def embed_texts(self, texts: List[str]) -> np.ndarray:
         limit = self.config.encoder.max_encode_len
-        token_lists = [self.encoder_tokenizer.encode(t)[:limit] for t in texts]
+        eos = getattr(self.encoder_tokenizer, "eos_id", None)
+        token_lists = []
+        for t in texts:
+            ids = self.encoder_tokenizer.encode(t)
+            if len(ids) > limit:
+                # keep the trailing EOS the encoder was trained to expect —
+                # a bare [:limit] cut drops it and skews the CLS embedding
+                ids = ids[:limit]
+                if eos is not None:
+                    ids[-1] = eos
+            token_lists.append(ids)
         return self.encoder.encode(token_lists)
 
     # -- ingest ---------------------------------------------------------

@@ -97,7 +97,10 @@ def build_service():
         dtypes=config.dtypes,
         mesh=mesh,
     )
-    encoder = EncoderRunner(config.encoder, enc_params, config.dtypes, mesh=mesh)
+    encoder = EncoderRunner(
+        config.encoder, enc_params, config.dtypes, mesh=mesh,
+        eos_id=getattr(enc_tokenizer, "eos_id", None),
+    )
 
     # fingerprint the embedder with a probe embedding so a persisted index
     # built by different encoder weights is detected and rebuilt

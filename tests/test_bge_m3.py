@@ -83,3 +83,31 @@ class TestXlmrParity:
             jnp.asarray(mask_np, jnp.int32),
         )
         np.testing.assert_allclose(np.asarray(ours), hf_embed, rtol=1e-3, atol=1e-4)
+
+
+class TestRunnerClamp:
+    def test_bucket_clamp_preserves_eos(self):
+        """A sequence over the runner's largest length bucket is clamped to
+        the bucket WITH its trailing EOS restored — the clamp must not undo
+        the server-level EOS-preserving truncation."""
+        from rag_llm_k8s_tpu.engine.encoder import EncoderRunner
+
+        cfg = EncoderConfig.tiny()
+        params = init_encoder_params(jax.random.PRNGKey(0), cfg, FP32)
+        runner = EncoderRunner(
+            cfg, params, dtypes=FP32, length_buckets=(8,), max_batch=2, eos_id=2
+        )
+        long_ids = [0] + [5] * 20 + [2]  # 22 ids, bucket is 8
+        short_ids = [0, 5, 6, 2]
+        clamped = runner.encode([long_ids])
+        # oracle: what the model gives for the explicitly clamped+EOS sequence
+        model = BgeM3Encoder(cfg, FP32)
+        want_ids = jnp.array([[0, 5, 5, 5, 5, 5, 5, 2]], jnp.int32)
+        want = model.apply({"params": params}, want_ids, jnp.ones_like(want_ids))
+        np.testing.assert_allclose(clamped, np.asarray(want), rtol=1e-4, atol=1e-5)
+        # short sequences are untouched
+        got_short = runner.encode([short_ids])
+        want_short_ids = jnp.array([[0, 5, 6, 2, 1, 1, 1, 1]], jnp.int32)
+        mask = (want_short_ids != 1).astype(jnp.int32)
+        want_short = model.apply({"params": params}, want_short_ids, mask)
+        np.testing.assert_allclose(got_short, np.asarray(want_short), rtol=1e-4, atol=1e-5)

@@ -140,3 +140,26 @@ class TestShardedEngine:
         )
         got = eng.generate([[3, 1, 4, 1, 5]])[0]
         assert got == want
+
+
+class TestSubBatchRNG:
+    def test_sub_batches_sample_independently(self, tiny_engine):
+        """A pinned seed must not make every sequential sub-batch draw the
+        same randomness: 8 identical prompts through a cap-4 engine land in
+        two sub-batches, whose sampled continuations should differ (the old
+        bug replayed one PRNGKey per sub-batch, duplicating outputs)."""
+        cfg, params, _ = tiny_engine
+        eng = InferenceEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=True, temperature=1.0, top_p=1.0,
+                                    max_new_tokens=8),
+            engine_config=SMALL_ENGINE, dtypes=FP32,
+        )
+        prompts = [[3, 17, 42]] * 8  # cap=4 -> exactly two sub-batches
+        outs = eng.generate(prompts, seed=123)
+        first, second = outs[:4], outs[4:]
+        assert first != second
+
+        # and the pinned seed is still fully reproducible end-to-end
+        outs2 = eng.generate(prompts, seed=123)
+        assert outs == outs2

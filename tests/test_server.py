@@ -179,3 +179,35 @@ class TestRoutes:
         assert c.get("/healthz").status_code == 503  # not warmed yet
         body = c.post("/generate", json={"prompt": "anything"}).get_json()
         assert body["generated_text"] == "No relevant information found in the index."
+
+
+class TestEmbedTruncation:
+    def test_truncation_preserves_eos(self):
+        """Over-limit encoder inputs keep their trailing EOS (the bge-m3 CLS
+        pipeline expects </s>-terminated sequences; a bare [:limit] cut used
+        to drop it)."""
+
+        class EosTokenizer(ByteTokenizer):
+            eos_id = 2
+
+            def encode(self, text):
+                return [1] + super().encode(text) + [2]
+
+        class RecordingEncoder:
+            def __init__(self):
+                self.seen = None
+
+            def encode(self, token_lists):
+                self.seen = [list(t) for t in token_lists]
+                return np.zeros((len(token_lists), 4), np.float32)
+
+        cfg = AppConfig(model=LlamaConfig.tiny(), encoder=EncoderConfig.tiny())
+        rec = RecordingEncoder()
+        svc = RagService(cfg, None, ByteTokenizer(), rec, EosTokenizer(), None)
+        limit = cfg.encoder.max_encode_len
+
+        svc.embed_texts(["x" * (limit * 2), "short"])
+        long_ids, short_ids = rec.seen
+        assert len(long_ids) == limit
+        assert long_ids[-1] == 2  # EOS survives truncation
+        assert short_ids[-1] == 2 and short_ids[0] == 1  # untouched
