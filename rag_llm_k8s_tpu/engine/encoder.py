@@ -19,6 +19,7 @@ from rag_llm_k8s_tpu.core.config import DTypePolicy, EncoderConfig
 from rag_llm_k8s_tpu.core.mesh import MeshContext
 from rag_llm_k8s_tpu.models.bge_m3 import BgeM3Encoder
 from rag_llm_k8s_tpu.utils.buckets import bucket_len, next_pow2
+from rag_llm_k8s_tpu.utils.tokens import truncate_keep_eos
 
 
 class EncoderRunner:
@@ -65,11 +66,7 @@ class EncoderRunner:
             tokens = np.full((B, S), pad, np.int32)
             mask = np.zeros((B, S), np.int32)
             for row, i in enumerate(group):
-                ids = list(token_lists[i])
-                if len(ids) > S:
-                    ids = ids[:S]
-                    if self.eos_id is not None:
-                        ids[-1] = self.eos_id
+                ids = truncate_keep_eos(token_lists[i], S, self.eos_id)
                 tokens[row, : len(ids)] = ids
                 mask[row, : len(ids)] = 1
             emb = self._jit(self.params, jnp.asarray(tokens), jnp.asarray(mask))

@@ -40,6 +40,7 @@ from rag_llm_k8s_tpu.index.store import VectorStore
 from rag_llm_k8s_tpu.rag.chunking import split_text
 from rag_llm_k8s_tpu.rag.pdf import extract_text
 from rag_llm_k8s_tpu.rag.prompt import assemble_context, assemble_prompt, extract_answer
+from rag_llm_k8s_tpu.utils.tokens import truncate_keep_eos
 
 logger = logging.getLogger(__name__)
 
@@ -90,16 +91,10 @@ class RagService:
     def embed_texts(self, texts: List[str]) -> np.ndarray:
         limit = self.config.encoder.max_encode_len
         eos = getattr(self.encoder_tokenizer, "eos_id", None)
-        token_lists = []
-        for t in texts:
-            ids = self.encoder_tokenizer.encode(t)
-            if len(ids) > limit:
-                # keep the trailing EOS the encoder was trained to expect —
-                # a bare [:limit] cut drops it and skews the CLS embedding
-                ids = ids[:limit]
-                if eos is not None:
-                    ids[-1] = eos
-            token_lists.append(ids)
+        token_lists = [
+            truncate_keep_eos(self.encoder_tokenizer.encode(t), limit, eos)
+            for t in texts
+        ]
         return self.encoder.encode(token_lists)
 
     # -- ingest ---------------------------------------------------------

@@ -14,8 +14,14 @@ import json
 import re
 from typing import Dict, Iterable, List, Optional, Tuple
 
-# Python's `re` lacks \p{L}/\p{N}; translate HF regexes to equivalent
-# unicode-aware classes (letter = \w minus digits/underscore).
+try:  # the `regex` module compiles HF's \p{L}/\p{N} classes exactly
+    import regex as _regex
+except ImportError:  # pragma: no cover — baked into this environment
+    _regex = None
+
+# Fallback when only stdlib `re` exists: translate \p-classes to approximate
+# unicode-aware equivalents (letter ≈ \w minus digits/underscore; this counts
+# combining marks as letters, a known small deviation).
 _PCLASS_SUBS = [
     (r"[^\r\n\p{L}\p{N}]", r"(?:(?!\w)[^\r\n]|_)"),
     (r"[^\s\p{L}\p{N}]", r"(?:[^\s\w]|_)"),
@@ -30,22 +36,30 @@ def translate_hf_regex(pattern: str) -> str:
     return pattern
 
 
+def compile_hf_regex(pattern: str):
+    """Compile an HF tokenizers (oniguruma-style) pattern: exact via `regex`
+    when available, translated stdlib `re` otherwise."""
+    if _regex is not None:
+        return _regex.compile(pattern)
+    return re.compile(translate_hf_regex(pattern))
+
+
 # GPT-2's byte-level pre-tokenization regex (what a bare ByteLevel
 # pre-tokenizer with use_regex=True applies).
-_GPT2_PATTERN = translate_hf_regex(
+_GPT2_PATTERN = (
     r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"
 )
 
 # Llama-3's pattern (tokenizer.json carries it in a Split pre-tokenizer; this
-# is the translated default when none is specified).
-_LLAMA3_PATTERN = translate_hf_regex(
+# is the default when none is specified).
+_LLAMA3_PATTERN = (
     r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}{1,3}"
     r"| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+"
 )
 
 
 def _pattern_from_spec(spec: dict) -> str:
-    """Extract + translate the pre-tokenization regex from a tokenizer.json
+    """Extract the raw pre-tokenization regex from a tokenizer.json
     pre_tokenizer section (Split nodes carry explicit regexes; a bare
     ByteLevel with use_regex implies the GPT-2 pattern)."""
     pre = spec.get("pre_tokenizer") or {}
@@ -54,7 +68,7 @@ def _pattern_from_spec(spec: dict) -> str:
         if node.get("type") == "Split":
             pat = node.get("pattern", {})
             if "Regex" in pat:
-                return translate_hf_regex(pat["Regex"])
+                return pat["Regex"]
     for node in nodes:
         if node.get("type") == "ByteLevel" and node.get("use_regex", True):
             return _GPT2_PATTERN
@@ -97,7 +111,7 @@ class ByteLevelBPETokenizer:
         self.ranks: Dict[Tuple[str, str], int] = {m: r for r, m in enumerate(merges)}
         self.special_tokens = dict(special_tokens or {})
         self.id_to_special = {i: t for t, i in self.special_tokens.items()}
-        self._pattern = re.compile(pattern)
+        self._pattern = compile_hf_regex(pattern)
         self._special_re = (
             re.compile("|".join(re.escape(t) for t in sorted(self.special_tokens, key=len, reverse=True)))
             if self.special_tokens

@@ -1,7 +1,8 @@
 """Unigram (SentencePiece) tokenizer — the XLM-R / bge-m3 algorithm.
 
 Loads the HF ``tokenizer.json`` of a Unigram model and segments with Viterbi
-over piece log-probabilities (max-likelihood segmentation), with the
+over piece log-probabilities (max-likelihood segmentation), after the spec's
+normalizer (``tokenizer/normalize.py`` — NFKC/charsmap rules) and the
 Metaspace pre-tokenizer (word-initial ``▁``). Replaces the Rust tokenizer
 behind the reference's ``SentenceTransformer('BAAI/bge-m3')``
 (/root/reference/llm/rag.py:33).
@@ -10,7 +11,14 @@ behind the reference's ``SentenceTransformer('BAAI/bge-m3')``
 from __future__ import annotations
 
 import json
+import re
 from typing import Dict, Iterable, List, Optional, Tuple
+
+from rag_llm_k8s_tpu.tokenizer.normalize import (
+    Normalizer,
+    nmt_nfkc,
+    normalizer_from_spec,
+)
 
 _SPACE = "▁"  # ▁
 
@@ -24,6 +32,22 @@ class _Trie:
         self.score: float = 0.0
 
 
+def _metaspace_from_spec(spec: dict) -> Tuple[str, bool]:
+    """(replacement, prepend?) from a tokenizer.json pre_tokenizer section.
+    Defaults match SentencePiece exports: ``▁``, always prepended."""
+    pre = spec.get("pre_tokenizer") or {}
+    nodes = pre.get("pretokenizers", [pre]) if pre.get("type") == "Sequence" else [pre]
+    for node in nodes:
+        if node.get("type") == "Metaspace":
+            repl = node.get("replacement", _SPACE)
+            if "prepend_scheme" in node:
+                prepend = node["prepend_scheme"] != "never"
+            else:
+                prepend = node.get("add_prefix_space", True)
+            return repl, prepend
+    return _SPACE, True
+
+
 class UnigramTokenizer:
     def __init__(
         self,
@@ -33,6 +57,9 @@ class UnigramTokenizer:
         bos_id: Optional[int] = 0,
         eos_id: Optional[int] = 2,
         add_bos_eos: bool = True,
+        normalize: Optional[Normalizer] = None,
+        replacement: str = _SPACE,
+        prepend: bool = True,
     ):
         self.pieces = pieces
         self.unk_id = unk_id
@@ -40,9 +67,28 @@ class UnigramTokenizer:
         self.bos_id = bos_id
         self.eos_id = eos_id
         self.add_bos_eos = add_bos_eos
+        # bge-m3 (and every SentencePiece export) normalizes before
+        # segmenting; defaulting to nmt_nfkc keeps direct constructions
+        # (tests, fixtures) on the same behavior as spec-loaded tokenizers
+        self.normalize: Normalizer = nmt_nfkc if normalize is None else normalize
+        self.replacement = replacement
+        self.prepend = prepend
         self.id_to_piece = {i: p for i, (p, _) in enumerate(pieces)}
         for t, i in self.special_tokens.items():
             self.id_to_piece.setdefault(i, t)
+        # HF extracts special-token strings from raw text BEFORE
+        # normalization/pre-tokenization (AddedVocabulary); longest-first so
+        # overlapping specials match greedily
+        self._special_re = (
+            re.compile(
+                "|".join(
+                    re.escape(t)
+                    for t in sorted(self.special_tokens, key=len, reverse=True)
+                )
+            )
+            if self.special_tokens
+            else None
+        )
         self._root = _Trie()
         for i, (piece, score) in enumerate(pieces):
             node = self._root
@@ -94,13 +140,40 @@ class UnigramTokenizer:
                 ids.append(pid)
             pos = prev
         ids.reverse()
-        return ids
+        if self.unk_id is None:
+            return ids
+        # HF Unigram fuses runs of unknown characters into ONE <unk>; the
+        # per-char fallback above must collapse the same way for id parity
+        fused: List[int] = []
+        for pid in ids:
+            if pid == self.unk_id and fused and fused[-1] == self.unk_id:
+                continue
+            fused.append(pid)
+        return fused
+
+    def _encode_segment(self, text: str) -> List[int]:
+        """Normalize + Metaspace + Viterbi over one special-free span."""
+        text = self.normalize(text)
+        if not text:
+            return []
+        # Metaspace: spaces → ▁, word-initial ▁ (sentencepiece handling)
+        body = text.replace(" ", self.replacement)
+        if self.prepend and not body.startswith(self.replacement):
+            body = self.replacement + body
+        return self._viterbi(body)
 
     def encode(self, text: str, add_special: Optional[bool] = None) -> List[int]:
         add_special = self.add_bos_eos if add_special is None else add_special
-        # Metaspace: prepend ▁, spaces → ▁ (sentencepiece whitespace handling)
-        body = _SPACE + text.strip().replace(" ", _SPACE)
-        ids = self._viterbi(body)
+        if self._special_re is None:
+            ids = self._encode_segment(text)
+        else:
+            ids = []
+            pos = 0
+            for m in self._special_re.finditer(text):
+                ids.extend(self._encode_segment(text[pos : m.start()]))
+                ids.append(self.special_tokens[m.group()])
+                pos = m.end()
+            ids.extend(self._encode_segment(text[pos:]))
         if add_special and self.bos_id is not None and self.eos_id is not None:
             return [self.bos_id] + ids + [self.eos_id]
         return ids
@@ -117,7 +190,7 @@ class UnigramTokenizer:
             if skip_special_tokens and i in specials:
                 continue
             parts.append(self.id_to_piece.get(i, ""))
-        return "".join(parts).replace(_SPACE, " ").strip()
+        return "".join(parts).replace(self.replacement, " ").strip()
 
     # ------------------------------------------------------------------
     @classmethod
@@ -133,10 +206,14 @@ class UnigramTokenizer:
         }
         bos = specials.get("<s>")
         eos = specials.get("</s>")
+        replacement, prepend = _metaspace_from_spec(spec)
         return cls(
             pieces=pieces,
             unk_id=model.get("unk_id"),
             special_tokens=specials,
             bos_id=bos,
             eos_id=eos,
+            normalize=normalizer_from_spec(spec.get("normalizer")),
+            replacement=replacement,
+            prepend=prepend,
         )

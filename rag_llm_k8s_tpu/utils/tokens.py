@@ -1,0 +1,20 @@
+"""Small token-sequence utilities shared across the serving stack."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+def truncate_keep_eos(
+    ids: Sequence[int], limit: int, eos_id: Optional[int]
+) -> List[int]:
+    """Cut ``ids`` to ``limit``, restoring the trailing EOS the encoder was
+    trained to expect — a bare ``[:limit]`` slice drops it and skews
+    CLS-pooled embeddings (bge-m3 inputs are ``</s>``-terminated)."""
+    ids = list(ids)
+    if len(ids) <= limit:
+        return ids
+    ids = ids[:limit]
+    if eos_id is not None:
+        ids[-1] = eos_id
+    return ids

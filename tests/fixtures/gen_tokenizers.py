@@ -1,0 +1,96 @@
+"""Regenerate the committed tokenizer.json parity fixtures.
+
+The HF ``tokenizers`` Unigram trainer is nondeterministic run-to-run
+(multithreaded EM), and Viterbi path scores for punctuation runs like
+``!!!`` can tie at float-ulp level, so parity tests against a FRESHLY
+trained model are flaky by construction. Training once and committing the
+resulting ``tokenizer.json`` files makes the parity suite deterministic
+while still comparing against the live Rust engine at test time.
+
+Run from the repo root:  python tests/fixtures/gen_tokenizers.py
+"""
+
+import os
+
+from tokenizers import Regex, Tokenizer, normalizers
+from tokenizers.decoders import ByteLevel as ByteLevelDecoder
+from tokenizers.models import BPE, Unigram
+from tokenizers.pre_tokenizers import ByteLevel, Metaspace
+from tokenizers.trainers import BpeTrainer, UnigramTrainer
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "tokenizers")
+
+CORPUS = [
+    "The Technology Radar is a snapshot of tools, techniques, platforms and languages.",
+    "Retrieval-augmented generation improves factuality of large language models.",
+    "TPU v5e slices communicate over ICI links; XLA emits the collectives.",
+    "def split_text(text, chunk_size=1000, overlap=200):",
+    "Hello world! 12345 -- naive tokenization tests, with punctuation...",
+    "Multilingual text: cafe, uber, naive.",
+] * 8
+
+MULTI_CORPUS = CORPUS + [
+    "기술 레이더는 도구, 기법, 플랫폼의 스냅샷입니다.",
+    "검색 증강 생성은 대규모 언어 모델의 사실성을 개선합니다.",
+    "日本語のテキストも正しく分割されるべきです。",
+    "café naïve über résumé — ça va?",
+    "emoji test 🚀 🧭 fin",
+] * 8
+
+
+def gen_bpe(path: str, corpus, vocab_size=400, special=()):
+    tok = Tokenizer(BPE(unk_token=None))
+    tok.pre_tokenizer = ByteLevel(add_prefix_space=False, use_regex=True)
+    tok.decoder = ByteLevelDecoder()
+    trainer = BpeTrainer(
+        vocab_size=vocab_size,
+        special_tokens=list(special),
+        initial_alphabet=ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train_from_iterator(corpus, trainer)
+    tok.save(path)
+
+
+def gen_unigram(path: str, corpus, vocab_size=300, normalized=False):
+    tok = Tokenizer(Unigram())
+    if normalized:
+        # declarative equivalent of bge-m3's Precompiled nmt_nfkc charsmap
+        # (the trainer cannot emit a Precompiled node)
+        tok.normalizer = normalizers.Sequence(
+            [
+                normalizers.NFKC(),
+                normalizers.Replace(Regex(r"\s+"), " "),
+                normalizers.Strip(),
+            ]
+        )
+    tok.pre_tokenizer = Metaspace()
+    trainer = UnigramTrainer(
+        vocab_size=vocab_size,
+        special_tokens=["<s>", "</s>", "<unk>"],
+        unk_token="<unk>",
+        show_progress=False,
+    )
+    tok.train_from_iterator(corpus, trainer)
+    tok.save(path)
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    gen_bpe(
+        os.path.join(OUT, "bpe_ascii.json"),
+        CORPUS,
+        special=["<|begin_of_text|>", "<|end_of_text|>"],
+    )
+    gen_bpe(os.path.join(OUT, "bpe_multi.json"), MULTI_CORPUS)
+    gen_unigram(os.path.join(OUT, "unigram_plain.json"), CORPUS)
+    gen_unigram(
+        os.path.join(OUT, "unigram_norm.json"), MULTI_CORPUS, vocab_size=600,
+        normalized=True,
+    )
+    print("fixtures written to", OUT)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,28 +1,27 @@
 """Tokenizer parity tests against the HF Rust ``tokenizers`` library (the
-exact engine the reference uses via AutoTokenizer, rag.py:25): train a small
-byte-level BPE / Unigram model, save tokenizer.json, reload with the
-framework's pure-Python implementations, and compare token ids exactly."""
+exact engine the reference uses via AutoTokenizer, rag.py:25): load the
+committed ``tokenizer.json`` fixtures (tests/fixtures/tokenizers/, generated
+once by tests/fixtures/gen_tokenizers.py — training fresh each run is
+nondeterministic and float-tie flaky), reload them with the framework's
+pure-Python implementations, and compare token ids exactly."""
+
+import os
 
 import pytest
 
 tokenizers = pytest.importorskip("tokenizers")
 
 from tokenizers import Tokenizer  # noqa: E402
-from tokenizers.models import BPE, Unigram  # noqa: E402
-from tokenizers.pre_tokenizers import ByteLevel, Metaspace  # noqa: E402
-from tokenizers.decoders import ByteLevel as ByteLevelDecoder  # noqa: E402
-from tokenizers.trainers import BpeTrainer, UnigramTrainer  # noqa: E402
 
 from rag_llm_k8s_tpu.tokenizer import load_tokenizer  # noqa: E402
 
-CORPUS = [
-    "The Technology Radar is a snapshot of tools, techniques, platforms and languages.",
-    "Retrieval-augmented generation improves factuality of large language models.",
-    "TPU v5e slices communicate over ICI links; XLA emits the collectives.",
-    "def split_text(text, chunk_size=1000, overlap=200):",
-    "Hello world! 12345 -- naive tokenization tests, with punctuation...",
-    "Multilingual text: cafe, uber, naive.",
-] * 8
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "tokenizers")
+
+
+def fixture_pair(name):
+    path = os.path.join(FIXTURES, name)
+    return Tokenizer.from_file(path), load_tokenizer(path)
+
 
 SAMPLES = [
     "The Technology Radar improves tools and platforms.",
@@ -33,23 +32,27 @@ SAMPLES = [
     "a",
 ]
 
+NON_ASCII_SAMPLES = [
+    "기술 레이더는 도구의 스냅샷입니다.",  # Korean (the reference corpus language)
+    "日本語のテキストも分割されるべきです。",
+    "café naïve über résumé — ça va?",
+    "cafe\u0301 composed",  # NFD input: e+combining-acute must compose first
+    "ＦＵＬＬｗｉｄｔｈ１２３",  # fullwidth forms fold to ASCII under NFKC
+    "nbsp\xa0and em-space",  # unicode spaces normalize to plain space
+    "emoji 🚀 test",
+    "ψψφ consecutive unknowns ψ",  # runs of OOV chars must fuse to one <unk>
+]
+
+# literal special-token strings inside ordinary text: HF extracts them
+# before normalization (AddedVocabulary), and unk-fusing must not swallow a
+# real '<unk>' match into an adjacent OOV run
+SPECIAL_IN_TEXT_SAMPLES = ["ψ<unk>ψ", "a <s> b", "<s>hello</s>", "<unk><unk>"]
+
 
 class TestBPEParity:
     @pytest.fixture(scope="class")
-    def pair(self, tmp_path_factory):
-        tok = Tokenizer(BPE(unk_token=None))
-        tok.pre_tokenizer = ByteLevel(add_prefix_space=False, use_regex=True)
-        tok.decoder = ByteLevelDecoder()
-        trainer = BpeTrainer(
-            vocab_size=400,
-            special_tokens=["<|begin_of_text|>", "<|end_of_text|>"],
-            initial_alphabet=ByteLevel.alphabet(),
-            show_progress=False,
-        )
-        tok.train_from_iterator(CORPUS, trainer)
-        p = tmp_path_factory.mktemp("bpe") / "tokenizer.json"
-        tok.save(str(p))
-        return tok, load_tokenizer(str(p))
+    def pair(self):
+        return fixture_pair("bpe_ascii.json")
 
     @pytest.mark.parametrize("text", SAMPLES)
     def test_encode_matches_rust(self, pair, text):
@@ -72,21 +75,30 @@ class TestBPEParity:
         assert got[1:-1] == rust.encode("hello world").ids
 
 
+class TestBPENonAscii:
+    """Byte-level BPE must byte-fall-back through any unicode input with ids
+    identical to the Rust engine (exact \\p{L}/\\p{N} splitting via `regex`)."""
+
+    @pytest.fixture(scope="class")
+    def pair(self):
+        return fixture_pair("bpe_multi.json")
+
+    @pytest.mark.parametrize("text", NON_ASCII_SAMPLES)
+    def test_encode_matches_rust(self, pair, text):
+        rust, ours = pair
+        assert ours.encode(text) == rust.encode(text).ids
+
+    @pytest.mark.parametrize("text", NON_ASCII_SAMPLES)
+    def test_decode_roundtrip(self, pair, text):
+        rust, ours = pair
+        ids = ours.encode(text)
+        assert ours.decode(ids) == rust.decode(ids) == text
+
+
 class TestUnigramParity:
     @pytest.fixture(scope="class")
-    def pair(self, tmp_path_factory):
-        tok = Tokenizer(Unigram())
-        tok.pre_tokenizer = Metaspace()
-        trainer = UnigramTrainer(
-            vocab_size=300,
-            special_tokens=["<s>", "</s>", "<unk>"],
-            unk_token="<unk>",
-            show_progress=False,
-        )
-        tok.train_from_iterator(CORPUS, trainer)
-        p = tmp_path_factory.mktemp("uni") / "tokenizer.json"
-        tok.save(str(p))
-        return tok, load_tokenizer(str(p))
+    def pair(self):
+        return fixture_pair("unigram_plain.json")
 
     @pytest.mark.parametrize("text", [s for s in SAMPLES if s])
     def test_encode_matches_rust(self, pair, text):
@@ -114,30 +126,79 @@ class TestUnigramParity:
         assert ours.unk_id in ids  # '+' is not in the trained vocab
 
 
-class TestNativeBPE:
-    def test_native_matches_python(self, tmp_path):
-        """The C++ merge loop must produce identical ids to the Python path."""
-        from tokenizers import Tokenizer
-        from tokenizers.models import BPE
-        from tokenizers.pre_tokenizers import ByteLevel
-        from tokenizers.trainers import BpeTrainer
+class TestUnigramNormalizedParity:
+    """Parity with a normalizer in the pipeline (bge-m3's tokenizer.json
+    carries a Precompiled charsmap ≈ NFKC + whitespace folding; the HF
+    trainer can't emit Precompiled, so the equivalent declarative chain
+    stands in for it)."""
 
-        tok = Tokenizer(BPE(unk_token=None))
-        tok.pre_tokenizer = ByteLevel(add_prefix_space=False, use_regex=True)
-        tok.train_from_iterator(
-            CORPUS,
-            BpeTrainer(
-                vocab_size=300,
-                initial_alphabet=ByteLevel.alphabet(),
-                show_progress=False,
-            ),
+    @pytest.fixture(scope="class")
+    def pair(self):
+        return fixture_pair("unigram_norm.json")
+
+    @pytest.mark.parametrize("text", NON_ASCII_SAMPLES)
+    def test_encode_matches_rust(self, pair, text):
+        rust, ours = pair
+        assert ours.encode(text, add_special=False) == rust.encode(text).ids
+
+    @pytest.mark.parametrize("text", [s for s in SAMPLES if s])
+    def test_ascii_still_matches(self, pair, text):
+        rust, ours = pair
+        assert ours.encode(text, add_special=False) == rust.encode(text).ids
+
+    @pytest.mark.parametrize("text", SPECIAL_IN_TEXT_SAMPLES)
+    def test_literal_special_tokens_in_text(self, pair, text):
+        rust, ours = pair
+        assert ours.encode(text, add_special=False) == rust.encode(text).ids
+
+
+class TestNmtNfkc:
+    """Unit behavior of the reimplemented SentencePiece nmt_nfkc rules (what
+    bge-m3's Precompiled charsmap encodes)."""
+
+    def test_unicode_spaces_fold(self):
+        from rag_llm_k8s_tpu.tokenizer.normalize import nmt_nfkc
+
+        assert nmt_nfkc("a\xa0b　c d") == "a b c d"
+
+    def test_controls_and_zero_width_dropped(self):
+        from rag_llm_k8s_tpu.tokenizer.normalize import nmt_nfkc
+
+        assert nmt_nfkc("a\x07b​c﻿d") == "abcd"
+
+    def test_nfkc_folds_fullwidth_and_composes(self):
+        from rag_llm_k8s_tpu.tokenizer.normalize import nmt_nfkc
+
+        assert nmt_nfkc("ＡＢＣ１２３") == "ABC123"
+        assert nmt_nfkc("café") == "café"
+
+    def test_whitespace_runs_collapse_and_strip(self):
+        from rag_llm_k8s_tpu.tokenizer.normalize import nmt_nfkc
+
+        assert nmt_nfkc("  a \t b\n\nc  ") == "a b c"
+
+    def test_precompiled_spec_maps_to_nmt_nfkc(self):
+        from rag_llm_k8s_tpu.tokenizer.normalize import (
+            nmt_nfkc,
+            normalizer_from_spec,
         )
-        p = tmp_path / "tokenizer.json"
-        tok.save(str(p))
-        ours = load_tokenizer(str(p))
+
+        fn = normalizer_from_spec({"type": "Precompiled", "precompiled_charsmap": "x"})
+        assert fn is nmt_nfkc
+
+    def test_korean_text_survives(self):
+        from rag_llm_k8s_tpu.tokenizer.normalize import nmt_nfkc
+
+        assert nmt_nfkc("기술 레이더") == "기술 레이더"
+
+
+class TestNativeBPE:
+    def test_native_matches_python(self):
+        """The C++ merge loop must produce identical ids to the Python path."""
+        rust, ours = fixture_pair("bpe_multi.json")
         if ours._native is None:
             pytest.skip("no C++ toolchain in this environment")
-        for text in SAMPLES + ["unicode: café — naïve", "x" * 500]:
+        for text in SAMPLES + NON_ASCII_SAMPLES + ["x" * 500]:
             native_ids = ours.encode(text)
             nat = ours._native
             ours._native = None
@@ -147,4 +208,4 @@ class TestNativeBPE:
             finally:
                 ours._native = nat
             assert native_ids == python_ids, text
-            assert native_ids == tok.encode(text).ids, text
+            assert native_ids == rust.encode(text).ids, text
