@@ -79,8 +79,13 @@ class VectorStore:
         self._metadata: List[Dict] = []
         self._hashes: set = set()
         self.generation = 0
-        # device snapshot (rebuilt lazily after mutation)
+        # device snapshot: padded [cap, D] embeddings + [1, cap] squared
+        # norms. Mutation appends rows IN PLACE on device via
+        # dynamic_update_slice (O(batch) transfer); only outgrowing the
+        # padded bucket forces a full re-upload (O(log N) times ever).
         self._dev: Optional[Tuple[jax.Array, jax.Array]] = None
+        # observability: ingest-path transfer accounting (tests assert on it)
+        self.transfer_stats = {"row_update_batches": 0, "full_uploads": 0}
 
     # ------------------------------------------------------------------
     # mutation (single-writer)
@@ -109,12 +114,32 @@ class VectorStore:
                 fresh_h.append(h)
             if not fresh_v:
                 return 0
-            self._vectors = np.concatenate([self._vectors, np.stack(fresh_v)], axis=0)
+            n_old = len(self._metadata)
+            new_rows = np.stack(fresh_v)
+            self._vectors = np.concatenate([self._vectors, new_rows], axis=0)
             self._metadata.extend(fresh_m)
             self._hashes.update(fresh_h)
             self.generation += 1
-            self._dev = None
+            self._append_device_rows(n_old, new_rows)
         return len(fresh_v)
+
+    def _append_device_rows(self, n_old: int, new_rows: np.ndarray):
+        """Write freshly added rows into the live device snapshot in place;
+        drop the snapshot only when the padded bucket is outgrown (the next
+        search rebuilds at the larger bucket). Caller holds the lock."""
+        if self._dev is None:
+            return  # nothing materialized yet; first search uploads once
+        emb, norms = self._dev
+        n_total = n_old + new_rows.shape[0]
+        if n_total > emb.shape[0]:
+            self._dev = None  # bucket growth: full re-upload on next search
+            return
+        rows = jnp.asarray(new_rows)  # the only host->device transfer: O(batch)
+        emb = jax.lax.dynamic_update_slice(emb, rows, (n_old, 0))
+        new_norms = jnp.sum(rows * rows, axis=1)[None, :]
+        norms = jax.lax.dynamic_update_slice(norms, new_norms, (0, n_old))
+        self._dev = (emb, norms)
+        self.transfer_stats["row_update_batches"] += 1
 
     # ------------------------------------------------------------------
     # search (on device)
@@ -130,6 +155,7 @@ class VectorStore:
             norms = np.full((1, n_pad), BIG, np.float32)
             norms[0, :n] = (self._vectors**2).sum(axis=1)
             self._dev = (jnp.asarray(emb), jnp.asarray(norms))
+            self.transfer_stats["full_uploads"] += 1
             return self._dev
 
     def search(self, query: np.ndarray, k: int = 5) -> List[SearchResult]:

@@ -14,6 +14,8 @@ import json
 import re
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from rag_llm_k8s_tpu.utils.tokens import compile_special_re
+
 try:  # the `regex` module compiles HF's \p{L}/\p{N} classes exactly
     import regex as _regex
 except ImportError:  # pragma: no cover — baked into this environment
@@ -112,11 +114,7 @@ class ByteLevelBPETokenizer:
         self.special_tokens = dict(special_tokens or {})
         self.id_to_special = {i: t for t, i in self.special_tokens.items()}
         self._pattern = compile_hf_regex(pattern)
-        self._special_re = (
-            re.compile("|".join(re.escape(t) for t in sorted(self.special_tokens, key=len, reverse=True)))
-            if self.special_tokens
-            else None
-        )
+        self._special_re = compile_special_re(self.special_tokens)
         self._b2u = byte_to_unicode()
         self._u2b = unicode_to_byte()
         self._cache: Dict[str, List[int]] = {}

@@ -54,6 +54,10 @@ def nmt_nfkc(text: str, collapse_ws: bool = True) -> str:
     return text
 
 
+def _precompiled(text: str) -> str:
+    return nmt_nfkc(text, collapse_ws=False)
+
+
 def _replace_fn(node: dict) -> Normalizer:
     from rag_llm_k8s_tpu.tokenizer.bpe import compile_hf_regex
 
@@ -61,9 +65,11 @@ def _replace_fn(node: dict) -> Normalizer:
     content = node.get("content", "")
     if "String" in pat:
         return lambda t, s=pat["String"], c=content: t.replace(s, c)
-    # oniguruma-style pattern (\p{..} classes are common in SPM exports)
+    # oniguruma-style pattern (\p{..} classes are common in SPM exports);
+    # HF substitutes `content` LITERALLY — no backslash-escape/group
+    # expansion, hence the lambda instead of a template string
     rx = compile_hf_regex(pat.get("Regex", ""))
-    return lambda t, r=rx, c=content: r.sub(c, t)
+    return lambda t, r=rx, c=content: r.sub(lambda _m: c, t)
 
 
 def _strip_fn(node: dict) -> Normalizer:
@@ -106,7 +112,11 @@ def normalizer_from_spec(spec: Optional[dict]) -> Normalizer:
         pre = spec.get("prepend", "")
         return lambda t, p=pre: (p + t) if t else t
     if kind == "Precompiled":
-        return nmt_nfkc
+        # the charsmap is a per-character mapping: it folds separators and
+        # applies NFKC-style rules but CANNOT collapse runs or strip ends —
+        # specs that want folding add an explicit Replace node after it
+        # (bge-m3: Sequence[Precompiled, Replace(" {2,}" -> " ")])
+        return _precompiled
     if kind == "Nmt":
         return _nmt_clean
     # unknown node: pass text through rather than silently mis-normalizing —

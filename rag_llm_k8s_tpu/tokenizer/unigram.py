@@ -11,7 +11,6 @@ behind the reference's ``SentenceTransformer('BAAI/bge-m3')``
 from __future__ import annotations
 
 import json
-import re
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from rag_llm_k8s_tpu.tokenizer.normalize import (
@@ -19,6 +18,7 @@ from rag_llm_k8s_tpu.tokenizer.normalize import (
     nmt_nfkc,
     normalizer_from_spec,
 )
+from rag_llm_k8s_tpu.utils.tokens import compile_special_re
 
 _SPACE = "▁"  # ▁
 
@@ -77,18 +77,8 @@ class UnigramTokenizer:
         for t, i in self.special_tokens.items():
             self.id_to_piece.setdefault(i, t)
         # HF extracts special-token strings from raw text BEFORE
-        # normalization/pre-tokenization (AddedVocabulary); longest-first so
-        # overlapping specials match greedily
-        self._special_re = (
-            re.compile(
-                "|".join(
-                    re.escape(t)
-                    for t in sorted(self.special_tokens, key=len, reverse=True)
-                )
-            )
-            if self.special_tokens
-            else None
-        )
+        # normalization/pre-tokenization (AddedVocabulary)
+        self._special_re = compile_special_re(self.special_tokens)
         self._root = _Trie()
         for i, (piece, score) in enumerate(pieces):
             node = self._root

@@ -2,7 +2,18 @@
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import re
+from typing import Iterable, List, Optional, Sequence
+
+
+def compile_special_re(special_tokens: Iterable[str]):
+    """Longest-first escaped alternation matching literal special-token
+    strings in raw text (HF AddedVocabulary extraction order), or ``None``
+    when there are none."""
+    toks = sorted(special_tokens, key=len, reverse=True)
+    if not toks:
+        return None
+    return re.compile("|".join(re.escape(t) for t in toks))
 
 
 def truncate_keep_eos(

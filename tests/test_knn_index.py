@@ -163,3 +163,44 @@ class TestVectorStore:
         store.add(v2, [{"text": f"b{i}"} for i in range(50)])
         res = store.search(v2[10], k=1)  # needs 1024-pad snapshot
         assert res[0].metadata["text"] == "b10"
+
+
+class TestIncrementalDeviceIndex:
+    def test_adds_within_bucket_are_row_updates(self):
+        """Ingest must not re-upload the whole padded matrix per add: once a
+        snapshot exists, in-bucket adds transfer only the new rows."""
+        rng = np.random.RandomState(7)
+        store = VectorStore(dim=8)
+        v0 = rng.randn(20, 8).astype(np.float32)
+        store.add(v0, [{"text": f"a{i}"} for i in range(20)])
+        _ = store.search(v0[0], k=1)  # materializes the 512-pad snapshot
+        assert store.transfer_stats == {"row_update_batches": 0, "full_uploads": 1}
+
+        for b in range(5):  # five more batches, all within the 512 bucket
+            vb = rng.randn(30, 8).astype(np.float32)
+            store.add(vb, [{"text": f"b{b}_{i}"} for i in range(30)])
+            last = vb[-1]
+        assert store.transfer_stats["row_update_batches"] == 5
+        assert store.transfer_stats["full_uploads"] == 1  # no re-uploads
+
+        # and the in-place snapshot ranks exactly like a fresh rebuild
+        res = store.search(last, k=3)
+        assert res[0].metadata["text"] == "b4_29"
+        fresh = VectorStore(dim=8)
+        fresh.add(np.asarray(store._vectors), [dict(m) for m in store._metadata])
+        want = fresh.search(last, k=3)
+        assert [r.metadata["text"] for r in res] == [r.metadata["text"] for r in want]
+        assert [r.distance for r in res] == pytest.approx([r.distance for r in want])
+
+    def test_bucket_growth_triggers_one_full_upload(self):
+        rng = np.random.RandomState(8)
+        store = VectorStore(dim=8)
+        store.add(rng.randn(500, 8).astype(np.float32),
+                  [{"text": f"a{i}"} for i in range(500)])
+        _ = store.search(np.zeros(8, np.float32), k=1)
+        v2 = rng.randn(50, 8).astype(np.float32)
+        store.add(v2, [{"text": f"b{i}"} for i in range(50)])  # outgrows 512
+        res = store.search(v2[10], k=1)
+        assert res[0].metadata["text"] == "b10"
+        assert store.transfer_stats["full_uploads"] == 2
+        assert store.transfer_stats["row_update_batches"] == 0

@@ -177,14 +177,26 @@ class TestNmtNfkc:
 
         assert nmt_nfkc("  a \t b\n\nc  ") == "a b c"
 
-    def test_precompiled_spec_maps_to_nmt_nfkc(self):
-        from rag_llm_k8s_tpu.tokenizer.normalize import (
-            nmt_nfkc,
-            normalizer_from_spec,
-        )
+    def test_precompiled_spec_applies_charsmap_rules(self):
+        """Precompiled is a PER-CHARACTER map: separators fold and NFKC
+        applies, but runs are NOT collapsed and ends are NOT stripped (the
+        real bge-m3 spec adds a separate Replace node for collapsing)."""
+        from rag_llm_k8s_tpu.tokenizer.normalize import normalizer_from_spec
 
         fn = normalizer_from_spec({"type": "Precompiled", "precompiled_charsmap": "x"})
-        assert fn is nmt_nfkc
+        assert fn("hello\n") == "hello "  # trailing separator kept (as space)
+        assert fn(" a\xa0 b") == " a  b"  # no strip, no run collapse
+        assert fn("ＡＢＣ") == "ABC"
+
+    def test_replace_content_is_literal(self):
+        """HF substitutes Replace `content` literally — backslashes are not
+        template escapes or group references."""
+        from rag_llm_k8s_tpu.tokenizer.normalize import normalizer_from_spec
+
+        fn = normalizer_from_spec(
+            {"type": "Replace", "pattern": {"Regex": "(x)"}, "content": "a\\b"}
+        )
+        assert fn("x") == "a\\b"
 
     def test_korean_text_survives(self):
         from rag_llm_k8s_tpu.tokenizer.normalize import nmt_nfkc
