@@ -248,6 +248,10 @@ class EngineConfig:
     prompt_buckets: Tuple[int, ...] = (256, 512, 1024, 2048, 4096)
     # hard cap on prompt bucket + generated tokens (KV-cache budget)
     max_seq_len: int = 4096 + 256
+    # prompts longer than the largest bucket prefill through the cache in
+    # bucket-sized chunks (chunk_prefill_attention) up to this many tokens;
+    # beyond it the engine truncates LOUDLY (logged), never silently
+    max_chunked_prompt: int = 16384
     # attention backend: "auto" = fused Pallas kernels on TPU, XLA einsum
     # oracle elsewhere (see models.llama.Attention)
     attn_impl: str = "auto"

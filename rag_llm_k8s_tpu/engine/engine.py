@@ -26,6 +26,7 @@ Replaces the reference's per-request ``model.generate`` on CPU torch
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -48,6 +49,8 @@ from rag_llm_k8s_tpu.models.llama import (
     mask_window,
 )
 from rag_llm_k8s_tpu.utils.buckets import bucket_len, next_pow2
+
+logger = logging.getLogger(__name__)
 
 
 def _isin(tokens: jax.Array, ids: Tuple[int, ...]) -> jax.Array:
@@ -90,7 +93,11 @@ class InferenceEngine:
             attn_impl=engine_config.attn_impl,
             mesh=(mesh.mesh if mesh is not None and mesh.tp > 1 else None),
         )
-        self._compiled: Dict[Tuple[int, int, int], jax.stages.Compiled] = {}
+        # same params, STATIC chunked=True: prompts longer than the largest
+        # bucket prefill through the cache chunk by chunk (offset-causal
+        # chunk_prefill_attention) instead of being silently truncated
+        self.model_chunked = self.model.copy(chunked=True)
+        self._compiled: Dict[Tuple[int, int, int, Optional[int]], jax.stages.Compiled] = {}
         self._lock = threading.Lock()
         self._rng_counter = 0
         self.stats = EngineStats()
@@ -98,7 +105,13 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # compiled generate graph (one per (B, S, max_new))
     # ------------------------------------------------------------------
-    def _build_generate(self, B: int, S: int, max_new: int):
+    def _build_generate(self, B: int, S: int, max_new: int, chunk: Optional[int] = None):
+        """AOT-compile one generate executable.
+
+        ``chunk=None``: single-shot prefill at bucket ``S``. ``chunk=C``:
+        ``S`` is a multiple of ``C`` and the prompt prefills through the
+        cache in ``C``-sized chunks (long prompts — no silent truncation).
+        """
         cfg, dt, sampling = self.config, self.dtypes, self.sampling
         model = self.model
         # cache length rounds up to a 128 multiple so the fused decode kernel
@@ -110,16 +123,46 @@ class InferenceEngine:
         cache_dtype = dt.compute_dtype
         pad_id = self.pad_id
 
+        def prefill(params, tokens, positions, cache, kv_start):
+            if chunk is None:
+                return model.apply(
+                    {"params": params}, tokens, positions, cache,
+                    kv_start, jnp.full((B,), S, jnp.int32), jnp.int32(0),
+                    last_logit_only=True,
+                )
+            n_chunks = S // chunk
+            mc = self.model_chunked
+
+            def body(cache, ci):
+                wi = ci * chunk
+                tok_c = jax.lax.dynamic_slice(tokens, (0, wi), (B, chunk))
+                pos_c = jax.lax.dynamic_slice(positions, (0, wi), (B, chunk))
+                # last_logit_only also for interior chunks: their logits are
+                # discarded, so never materialize a [B, C, V] projection
+                _, cache = mc.apply(
+                    {"params": params}, tok_c, pos_c, cache,
+                    kv_start, jnp.broadcast_to(wi + chunk, (B,)).astype(jnp.int32),
+                    wi.astype(jnp.int32), last_logit_only=True,
+                )
+                return cache, None
+
+            if n_chunks > 1:
+                cache, _ = jax.lax.scan(
+                    body, cache, jnp.arange(n_chunks - 1, dtype=jnp.int32)
+                )
+            wi = (n_chunks - 1) * chunk
+            return mc.apply(
+                {"params": params}, tokens[:, wi:], positions[:, wi:], cache,
+                kv_start, jnp.full((B,), S, jnp.int32), jnp.int32(wi),
+                last_logit_only=True,
+            )
+
         def gen(params, tokens, pad_mask, rng):
             cache = make_kv_cache(cfg, B, T, cache_dtype)
             kv_start, _ = mask_window(pad_mask)  # left-pad: [S - real_len, S)
             real_len = jnp.sum(pad_mask, axis=-1)  # [B]
             positions = jnp.clip(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
-            logits, cache = model.apply(
-                {"params": params}, tokens, positions, cache,
-                kv_start, jnp.full((B,), S, jnp.int32), jnp.int32(0),
-                last_logit_only=True,
-            )
+            logits, cache = prefill(params, tokens, positions, cache, kv_start)
             rng, k0 = jax.random.split(rng)
             tok0 = sample_token(k0, logits[:, -1], sampling)
             done0 = _isin(tok0, eos_ids)
@@ -173,12 +216,14 @@ class InferenceEngine:
             .compile()
         )
 
-    def _get_compiled(self, B: int, S: int, max_new: int) -> jax.stages.Compiled:
-        key = (B, S, max_new)
+    def _get_compiled(
+        self, B: int, S: int, max_new: int, chunk: Optional[int] = None
+    ) -> jax.stages.Compiled:
+        key = (B, S, max_new, chunk)
         with self._lock:
             fn = self._compiled.get(key)
         if fn is None:
-            fn = self._build_generate(B, S, max_new)
+            fn = self._build_generate(B, S, max_new, chunk)
             with self._lock:
                 self._compiled.setdefault(key, fn)
                 fn = self._compiled[key]
@@ -250,14 +295,37 @@ class InferenceEngine:
         rng: jax.Array,
     ) -> List[List[int]]:
         """One device call for <= max_batch_size prompts with a decided rng."""
-        S = self._bucket_len(max(len(p) for p in prompts))
+        maxlen = max(len(p) for p in prompts)
+        largest = max(self.engine_config.prompt_buckets)
+        cap = self.engine_config.max_chunked_prompt
+        if maxlen > cap:
+            # the ONLY truncation in the engine — and a loud one
+            logger.warning(
+                "prompt of %d tokens exceeds max_chunked_prompt=%d; "
+                "left-truncating to the most recent %d tokens",
+                maxlen, cap, cap,
+            )
+            maxlen = cap
+        if maxlen <= largest:
+            S = self._bucket_len(maxlen)
+            chunk = None
+            max_new = self._clamp_max_new(S, max_new)
+        else:
+            # chunked prefill: pad to a multiple of the largest bucket and
+            # run the prompt through the cache chunk by chunk — no silent
+            # truncation. Decode keeps the same room the largest single-shot
+            # bucket gets (max_seq_len - largest), bounding cache HBM at
+            # T = S + that budget even for adversarial max_new_tokens.
+            chunk = largest
+            S = -(-maxlen // chunk) * chunk
+            budget = max(1, self.engine_config.max_seq_len - largest)
+            max_new = max(1, min(max_new, budget))
         B = self._bucket_batch(len(prompts))
-        max_new = self._clamp_max_new(S, max_new)
 
         tokens = np.full((B, S), self.pad_id, np.int32)
         pad_mask = np.zeros((B, S), np.int32)
         for i, p in enumerate(prompts):
-            p = list(p)[-S:]  # truncate from the left if over the largest bucket
+            p = list(p)[-maxlen:]  # no-op below the cap (maxlen = max row len)
             tokens[i, S - len(p):] = p
             pad_mask[i, S - len(p):] = 1
         # empty rows (batch padding) get one BOS so real_len >= 1
@@ -265,7 +333,7 @@ class InferenceEngine:
             tokens[i, -1] = self.config.bos_token_id
             pad_mask[i, -1] = 1
 
-        fn = self._get_compiled(B, S, max_new)
+        fn = self._get_compiled(B, S, max_new, chunk)
         tokens_j, mask_j, rng_j = self._place_inputs(tokens, pad_mask, rng)
         out = np.asarray(fn(self.params, tokens_j, mask_j, rng_j))
 

@@ -22,6 +22,7 @@ elsewhere) — ``ops/knn.py``.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import json
 import os
@@ -37,6 +38,25 @@ import numpy as np
 from rag_llm_k8s_tpu.ops.knn import BIG, knn_topk
 
 _FORMAT_VERSION = 1
+
+
+@jax.jit
+def _dev_append(emb, norms, rows, n_old, n_real):
+    """Produce a NEW snapshot with ``rows[:n_real]`` written at ``n_old``.
+
+    Deliberately NOT donated: concurrent searches hold references to the old
+    ``(emb, norms)`` pair outside the store lock — immutable snapshots are
+    the concurrency contract, and donation would invalidate them mid-search.
+    The cost is one device-side O(capacity) buffer copy per ingest batch
+    (HBM-to-HBM, ~ms even at GB scale — dwarfed by the embedding forward);
+    the host->device transfer stays O(batch). ``rows`` is padded to a
+    power-of-two row count to bound executable variants; padding rows carry
+    BIG norms so they stay unrankable until a later add overwrites them."""
+    emb = jax.lax.dynamic_update_slice(emb, rows.astype(emb.dtype), (n_old, 0))
+    real = jnp.arange(rows.shape[0]) < n_real
+    row_norms = jnp.where(real, jnp.sum(rows * rows, axis=1), BIG)[None, :]
+    norms = jax.lax.dynamic_update_slice(norms, row_norms, (0, n_old))
+    return emb, norms
 
 
 @dataclass
@@ -130,15 +150,20 @@ class VectorStore:
         if self._dev is None:
             return  # nothing materialized yet; first search uploads once
         emb, norms = self._dev
-        n_total = n_old + new_rows.shape[0]
-        if n_total > emb.shape[0]:
+        n_real = new_rows.shape[0]
+        from rag_llm_k8s_tpu.utils.buckets import next_pow2
+
+        n_pad = next_pow2(max(n_real, 1))
+        if n_old + n_pad > emb.shape[0]:
             self._dev = None  # bucket growth: full re-upload on next search
             return
-        rows = jnp.asarray(new_rows)  # the only host->device transfer: O(batch)
-        emb = jax.lax.dynamic_update_slice(emb, rows, (n_old, 0))
-        new_norms = jnp.sum(rows * rows, axis=1)[None, :]
-        norms = jax.lax.dynamic_update_slice(norms, new_norms, (0, n_old))
-        self._dev = (emb, norms)
+        rows = np.zeros((n_pad, new_rows.shape[1]), np.float32)
+        rows[:n_real] = new_rows
+        # one O(batch) host->device transfer; the donated jit updates the
+        # snapshot in place on device (no O(capacity) copy)
+        self._dev = _dev_append(
+            emb, norms, jnp.asarray(rows), jnp.int32(n_old), jnp.int32(n_real)
+        )
         self.transfer_stats["row_update_batches"] += 1
 
     # ------------------------------------------------------------------

@@ -38,6 +38,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
 from rag_llm_k8s_tpu.ops.attention import (
     attention_xla,
+    chunk_attention_xla,
+    chunk_prefill_attention,
     decode_attention,
     decode_attention_xla,
     flash_attention,
@@ -167,6 +169,11 @@ class Attention(nn.Module):
     dtypes: DTypePolicy
     attn_impl: str = "auto"  # "auto" | "pallas" | "pallas_interpret" | "xla"
     mesh: Optional[Mesh] = None  # enables shard_map-over-heads TP for kernels
+    # STATIC chunked-prefill switch: S > 1 calls attend over the whole
+    # populated cache prefix (offset causality) instead of just the fresh
+    # K/V — the engine builds a separate model instance with chunked=True
+    # for its long-prompt executables, so tracing never inspects write_index
+    chunked: bool = False
 
     def _resolved_impl(self) -> str:
         if self.attn_impl not in ("auto", "pallas", "pallas_interpret", "xla"):
@@ -178,13 +185,21 @@ class Attention(nn.Module):
             return "pallas" if jax.default_backend() == "tpu" else "xla"
         return self.attn_impl
 
-    def _attend(self, q, k, v, kv_start, kv_len, layer, *, decode: bool) -> jax.Array:
-        """Dispatch to the right backend; for decode, ``k``/``v`` are the FULL
-        stacked head-major cache ``[L, B, K, T, hd]`` read at ``layer`` (no
-        per-layer slice is ever materialized), otherwise fresh
-        ``[B, S, K, hd]``."""
+    def _attend(
+        self, q, k, v, kv_start, kv_len, layer, *, mode: str, write_index=None
+    ) -> jax.Array:
+        """Dispatch to the right backend. ``mode``:
+
+        - ``"prefill"``: fresh ``k``/``v`` ``[B, S, K, hd]``, causal within S;
+        - ``"decode"`` / ``"chunk"``: ``k``/``v`` are the FULL stacked
+          head-major cache ``[L, B, K, T, hd]`` read at ``layer`` (no
+          per-layer slice is ever materialized); ``chunk`` additionally takes
+          ``write_index`` — query ``t`` sits at cache slot ``write_index + t``
+          (offset causality over the populated prefix).
+        """
         impl = self._resolved_impl()
         mesh = self.mesh
+        cache_kv = mode in ("decode", "chunk")
         # kv heads sit at dim 2 in both layouts ([L,B,K,T,hd] / [B,S,K,hd])
         H, K = q.shape[2], k.shape[2]
         tp = (
@@ -199,14 +214,22 @@ class Attention(nn.Module):
             # gather — the sharding-transparent XLA path is strictly better
             impl = "xla"
         if impl == "xla":
-            if decode:
+            if mode == "decode":
                 return decode_attention_xla(q, k, v, kv_start, kv_len, layer)
+            if mode == "chunk":
+                return chunk_attention_xla(
+                    q, k, v, kv_start, kv_len, layer, write_index
+                )
             return attention_xla(q, k, v, kv_start=kv_start, kv_len=kv_len, causal=True)
 
         interpret = impl == "pallas_interpret"
-        if decode:
+        if mode == "decode":
             kernel = lambda q_, k_, v_, s_, l_, lay_: decode_attention(  # noqa: E731
                 q_, k_, v_, s_, l_, lay_, interpret=interpret
+            )
+        elif mode == "chunk":
+            kernel = lambda q_, k_, v_, s_, l_, lay_, wi_: chunk_prefill_attention(  # noqa: E731
+                q_, k_, v_, s_, l_, lay_, wi_, interpret=interpret
             )
         else:
             kernel = lambda q_, k_, v_, s_, l_: flash_attention(  # noqa: E731
@@ -219,12 +242,13 @@ class Attention(nn.Module):
             from jax.experimental.shard_map import shard_map
 
             hspec = P(None, None, "tp", None)
-            if decode:
+            if cache_kv:
                 kvspec = P(None, None, "tp", None, None)
+                scalars = (P(None),) * (3 if mode == "chunk" else 2)
                 kernel = shard_map(
                     kernel,
                     mesh=mesh,
-                    in_specs=(hspec, kvspec, kvspec, P(None), P(None), P(None)),
+                    in_specs=(hspec, kvspec, kvspec, P(None)) + scalars,
                     out_specs=hspec,
                     check_rep=False,
                 )
@@ -236,8 +260,14 @@ class Attention(nn.Module):
                     out_specs=hspec,
                     check_rep=False,
                 )
-        if decode:
+        if mode == "decode":
             return kernel(q, k, v, kv_start, kv_len, jnp.asarray(layer, jnp.int32).reshape(1))
+        if mode == "chunk":
+            return kernel(
+                q, k, v, kv_start, kv_len,
+                jnp.asarray(layer, jnp.int32).reshape(1),
+                jnp.asarray(write_index, jnp.int32).reshape(1),
+            )
         return kernel(q, k, v, kv_start, kv_len)
 
     @nn.compact
@@ -282,21 +312,28 @@ class Attention(nn.Module):
         )
 
         if S == 1:
-            out = self._attend(q, k_cache, v_cache, kv_start, kv_len, layer, decode=True)
+            out = self._attend(q, k_cache, v_cache, kv_start, kv_len, layer, mode="decode")
+        elif self.chunked:
+            # chunked prefill: this chunk's queries attend over the WHOLE
+            # populated cache prefix (earlier chunks + this one) with offset
+            # causality — query t sits at cache slot write_index + t
+            out = self._attend(
+                q, k_cache, v_cache, kv_start, kv_len, layer,
+                mode="chunk", write_index=write_index,
+            )
         else:
-            # prefill/training writes at slot 0, so the fresh K/V ARE the
-            # populated cache prefix — attend over S keys, not T cache slots.
-            # Chunked prefill (S > 1 at write_index > 0) is NOT supported by
-            # this path. The check is concrete-only: under tracing (nn.scan
+            # single-shot prefill/training writes at slot 0, so the fresh K/V
+            # ARE the populated cache prefix — attend over S keys, not T cache
+            # slots. The check is concrete-only: under tracing (nn.scan
             # broadcasts every argument as a tracer, as do init/eval_shape/
             # grad) the value can't be inspected, and every in-tree caller
-            # passes 0 for multi-token calls.
+            # passes 0 for non-chunked multi-token calls.
             if not isinstance(write_index, jax.core.Tracer):
                 assert int(write_index) == 0, (
-                    "multi-token calls must write at slot 0 (chunked prefill "
-                    "at write_index > 0 would need cache-wide attention)"
+                    "multi-token calls must write at slot 0 — build the model "
+                    "with chunked=True for prefill at write_index > 0"
                 )
-            out = self._attend(q, k, v, kv_start, kv_len, layer, decode=False)
+            out = self._attend(q, k, v, kv_start, kv_len, layer, mode="prefill")
         out = out.astype(dt.compute_dtype).reshape(B, S, H * hd)
         return dense(D, "wo")(out), (k_cache, v_cache)
 
@@ -326,12 +363,14 @@ class Block(nn.Module):
     dtypes: DTypePolicy
     attn_impl: str = "auto"
     mesh: Optional[Mesh] = None
+    chunked: bool = False
 
     @nn.compact
     def __call__(self, carry, kv_start, kv_len, cos, sin, write_index):
         h, kv, layer = carry
         attn_out, kv = Attention(
-            self.config, self.dtypes, self.attn_impl, self.mesh, name="attn"
+            self.config, self.dtypes, self.attn_impl, self.mesh, self.chunked,
+            name="attn",
         )(
             RMSNorm(self.config.rms_norm_eps, self.dtypes, name="input_norm")(h),
             kv, layer, kv_start, kv_len, cos, sin, write_index,
@@ -363,6 +402,7 @@ class LlamaModel(nn.Module):
     dtypes: DTypePolicy = DTypePolicy()
     attn_impl: str = "auto"  # see Attention.attn_impl ("xla" = differentiable)
     mesh: Optional[Mesh] = None
+    chunked: bool = False  # see Attention.chunked (long-prompt prefill)
 
     @nn.compact
     def __call__(
@@ -394,7 +434,9 @@ class LlamaModel(nn.Module):
             out_axes=0,
             length=c.num_layers,
         )
-        (h, (new_k, new_v), _), _ = ScanBlocks(c, dt, self.attn_impl, self.mesh, name="layers")(
+        (h, (new_k, new_v), _), _ = ScanBlocks(
+            c, dt, self.attn_impl, self.mesh, self.chunked, name="layers"
+        )(
             (h, (cache.k, cache.v), jnp.int32(0)), kv_start, kv_len, cos, sin, write_index
         )
 

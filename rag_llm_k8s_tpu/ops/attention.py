@@ -347,6 +347,192 @@ def decode_attention(
     return out.reshape(B, 1, H, hd)
 
 
+def _chunk_kernel(
+    layer_ref,  # SMEM [1] (consumed by the index maps)
+    wi_ref,  # SMEM [1]: write_index — global cache slot of query 0
+    kv_start_ref,  # SMEM [B]
+    kv_len_ref,  # SMEM [B]
+    q_ref,  # [1, bq, hd]
+    k_ref,  # [1, 1, 1, bk, hd]
+    v_ref,  # [1, 1, 1, bk, hd]
+    o_ref,  # [1, bq, hd]
+    m_scr,  # VMEM [bq, 1]
+    l_scr,  # VMEM [bq, 1]
+    acc_scr,  # VMEM [bq, hd]
+    *,
+    bq: int,
+    bk: int,
+    scale: float,
+    num_heads: int,
+):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+    b = bh // num_heads
+    wi = wi_ref[0]
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # block skip: K blocks inside the pad region / past the frontier /
+    # strictly above the OFFSET causal diagonal (query t sits at global
+    # cache slot wi + t) do no work
+    q_hi = wi + qi * bq + bq - 1  # last query slot of this q block
+    overlap = (kj * bk + bk > kv_start_ref[b]) & (kj * bk < kv_len_ref[b])
+    live = overlap & (kj * bk <= q_hi)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0, 0, 0]
+        v = v_ref[0, 0, 0]
+        # zero K/V rows outside the valid window BEFORE any matmul (cache
+        # slots past the frontier may be uninitialized; 0 * NaN = NaN)
+        cpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, 1), 0)
+        cok = (cpos >= kv_start_ref[b]) & (cpos < kv_len_ref[b])
+        k = jnp.where(cok, k, 0)
+        v = jnp.where(cok, v, 0)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+
+        q_pos = wi + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        ok = (k_pos >= kv_start_ref[b]) & (k_pos < kv_len_ref[b]) & (k_pos <= q_pos)
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(ok, jnp.exp(s - m_new), 0.0)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bq", "bk", "interpret"))
+def chunk_prefill_attention(
+    q: jax.Array,  # [B, S, H, hd] — one prompt chunk's fresh queries
+    k_cache: jax.Array,  # [L, B, K, T, hd] — FULL stacked head-major cache
+    v_cache: jax.Array,  # [L, B, K, T, hd]
+    kv_start: jax.Array,  # [B] int32: first valid cache slot
+    kv_len: jax.Array,  # [B] int32: valid frontier (= write_index + S)
+    layer: jax.Array,  # [] or [1] int32
+    write_index: jax.Array,  # [] or [1] int32: cache slot of query 0
+    bq: int = 256,
+    bk: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Cache-wide flash attention for CHUNKED prefill (``S > 1`` queries
+    written at ``write_index > 0``): each query attends over the whole
+    populated cache prefix — earlier chunks' slots AND its own chunk — under
+    offset causality (query ``t`` lives at cache slot ``write_index + t``).
+
+    Streams the head-major cache exactly like ``decode_attention`` (layer via
+    scalar prefetch into the block index map — no per-layer cache slice is
+    materialized) but with the blockwise flash recurrence of
+    ``flash_attention`` across ``bq`` query rows. The reference has no
+    equivalent: its torch path materializes full [S, T] score matrices and
+    cannot prefill beyond what fits one forward (rag.py:172)."""
+    B, S, H, hd = q.shape
+    L, _, K, T, _ = k_cache.shape
+    G = H // K
+    bq = _fit_block(S, bq)
+    bk = _decode_block(T, bk)
+    if not interpret and bk % 16:
+        raise ValueError(
+            f"cache length T={T} only tiles into blocks of {bk}: pad T to a "
+            "multiple of 128 — the engine rounds cache lengths for this"
+        )
+
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, S, hd)
+    grid = (B * H, S // bq, T // bk)
+
+    def kv_index(bh, qi, kj, layer_ref, *s_):
+        return (layer_ref[0], bh // H, (bh % H) // G, kj, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _chunk_kernel, bq=bq, bk=bk, scale=hd**-0.5, num_heads=H
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, hd), lambda bh, qi, kj, *s_: (bh, qi, 0)),
+                pl.BlockSpec((1, 1, 1, bk, hd), kv_index),
+                pl.BlockSpec((1, 1, 1, bk, hd), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, bq, hd), lambda bh, qi, kj, *s_: (bh, qi, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, hd), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        jnp.asarray(write_index, jnp.int32).reshape(1),
+        kv_start.astype(jnp.int32),
+        kv_len.astype(jnp.int32),
+        qt,
+        k_cache,
+        v_cache,
+    )
+
+    return out.reshape(B, H, S, hd).transpose(0, 2, 1, 3)
+
+
+def chunk_attention_xla(
+    q: jax.Array,  # [B, S, H, hd]
+    k_cache: jax.Array,  # [L, B, K, T, hd]
+    v_cache: jax.Array,  # [L, B, K, T, hd]
+    kv_start: jax.Array,  # [B]
+    kv_len: jax.Array,  # [B]
+    layer: jax.Array,  # [] or [1] int32
+    write_index: jax.Array,  # [] int32
+) -> jax.Array:
+    """Dense XLA reference for ``chunk_prefill_attention`` (oracle; fallback
+    off-TPU)."""
+    B, S, H, hd = q.shape
+    _, _, K, T, _ = k_cache.shape
+    G = H // K
+    lay = jnp.asarray(layer, jnp.int32).reshape(())
+    k = jax.lax.dynamic_index_in_dim(k_cache, lay, 0, keepdims=False)
+    v = jax.lax.dynamic_index_in_dim(v_cache, lay, 0, keepdims=False)
+    qg = q.reshape(B, S, K, G, hd)
+    s = jnp.einsum("bqkgd,bktd->bkgqt", qg, k, preferred_element_type=jnp.float32)
+    s = s * (hd**-0.5)
+    q_pos = jnp.asarray(write_index, jnp.int32).reshape(()) + jnp.arange(S)
+    t_pos = jnp.arange(T)
+    ok = (t_pos[None, None, :] >= kv_start[:, None, None]) & (
+        t_pos[None, None, :] < kv_len[:, None, None]
+    )
+    ok = ok & (t_pos[None, None, :] <= q_pos[None, :, None])  # [B, S, T]
+    s = jnp.where(ok[:, None, None, :, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(ok[:, None, None, :, :], p, 0.0)
+    o = jnp.einsum(
+        "bkgqt,bktd->bqkgd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return o.reshape(B, S, H, hd).astype(q.dtype)
+
+
 def decode_attention_xla(
     q: jax.Array,  # [B, 1, H, hd]
     k_cache: jax.Array,  # [L, B, K, T, hd]

@@ -163,3 +163,101 @@ class TestSubBatchRNG:
         # and the pinned seed is still fully reproducible end-to-end
         outs2 = eng.generate(prompts, seed=123)
         assert outs == outs2
+
+
+class TestChunkedPrefill:
+    """Prompts over the largest bucket prefill through the cache in chunks —
+    same tokens out as a single-shot engine whose bucket fits the prompt."""
+
+    def test_long_prompt_matches_big_bucket_oracle(self, tiny_engine):
+        cfg, params, _ = tiny_engine
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(2, cfg.vocab_size, 40).tolist()  # > largest bucket 32
+        eng = InferenceEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=EngineConfig(prompt_buckets=(16, 32), max_batch_size=4),
+            dtypes=FP32,
+        )
+        got = eng.generate([prompt])[0]
+        assert (1, 64, GREEDY.max_new_tokens, 32) in eng._compiled  # chunked exe
+
+        eng_big = InferenceEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=EngineConfig(prompt_buckets=(64,), max_batch_size=4),
+            dtypes=FP32,
+        )
+        want = eng_big.generate([prompt])[0]
+        assert got == want and len(got) > 0
+
+    def test_mixed_batch_long_and_short(self, tiny_engine):
+        cfg, params, _ = tiny_engine
+        rng = np.random.RandomState(1)
+        long_p = rng.randint(2, cfg.vocab_size, 50).tolist()
+        short_p = [3, 17, 42]
+        eng = InferenceEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=EngineConfig(prompt_buckets=(16, 32), max_batch_size=4),
+            dtypes=FP32,
+        )
+        got = eng.generate([long_p, short_p])
+        eng_big = InferenceEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=EngineConfig(prompt_buckets=(64,), max_batch_size=4),
+            dtypes=FP32,
+        )
+        want = eng_big.generate([long_p, short_p])
+        assert got == want
+
+    def test_over_cap_truncates_loudly(self, tiny_engine, caplog):
+        import logging
+
+        cfg, params, _ = tiny_engine
+        rng = np.random.RandomState(2)
+        prompt = rng.randint(2, cfg.vocab_size, 48).tolist()
+        eng = InferenceEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=EngineConfig(
+                prompt_buckets=(16, 32), max_batch_size=4, max_chunked_prompt=32
+            ),
+            dtypes=FP32,
+        )
+        with caplog.at_level(logging.WARNING, "rag_llm_k8s_tpu.engine.engine"):
+            got = eng.generate([prompt])[0]
+        assert any("max_chunked_prompt" in r.message for r in caplog.records)
+        # behavior after the loud warning: the most recent cap tokens serve
+        want = eng.generate([prompt[-32:]])[0]
+        assert got == want
+
+    def test_cap_not_multiple_of_bucket_enforced_exactly(self, tiny_engine):
+        """A cap that is not a bucket multiple must truncate to the cap
+        itself, not to the rounded-up chunked length."""
+        cfg, params, _ = tiny_engine
+        rng = np.random.RandomState(3)
+        prompt = rng.randint(2, cfg.vocab_size, 50).tolist()
+        eng = InferenceEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=EngineConfig(
+                prompt_buckets=(16, 32), max_batch_size=4, max_chunked_prompt=40
+            ),
+            dtypes=FP32,
+        )
+        got = eng.generate([prompt])[0]
+        want = eng.generate([prompt[-40:]])[0]  # exactly the stated contract
+        assert got == want
+
+    def test_chunked_max_new_is_bounded(self, tiny_engine):
+        """Adversarial max_new_tokens on the chunked path must clamp to the
+        decode budget (max_seq_len - largest bucket), not allocate freely."""
+        cfg, params, _ = tiny_engine
+        rng = np.random.RandomState(4)
+        prompt = rng.randint(2, cfg.vocab_size, 40).tolist()
+        eng = InferenceEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=EngineConfig(
+                prompt_buckets=(16, 32), max_batch_size=4, max_seq_len=48
+            ),
+            dtypes=FP32,
+        )
+        out = eng.generate([prompt], max_new_tokens=10_000)[0]
+        assert len(out) <= 48 - 32  # budget = max_seq_len - largest bucket
+        assert all(k[2] <= 16 for k in eng._compiled)  # no runaway executable

@@ -177,3 +177,53 @@ class TestModelPallasPath:
             rtol=5e-4, atol=5e-4,
         )
         np.testing.assert_allclose(np.asarray(d_got), np.asarray(d_ref), rtol=5e-4, atol=5e-4)
+
+
+class TestChunkPrefillAttention:
+    """Cache-wide chunked-prefill kernel (interpret mode) vs dense oracle,
+    and the chunked path's equivalence to single-shot prefill."""
+
+    def _problem(self, seed, B=2, S=64, H=8, K=2, T=256, hd=64, L=3, dtype=jnp.float32):
+        from rag_llm_k8s_tpu.ops.attention import (
+            chunk_attention_xla,
+            chunk_prefill_attention,
+        )
+
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (B, S, H, hd), dtype)
+        k_cache = jax.random.normal(ks[1], (L, B, K, T, hd), dtype)
+        v_cache = jax.random.normal(ks[2], (L, B, K, T, hd), dtype)
+        return q, k_cache, v_cache, chunk_prefill_attention, chunk_attention_xla
+
+    def test_matches_oracle_per_layer_and_offset(self):
+        q, kc, vc, kernel, oracle = self._problem(0)
+        S, T = q.shape[1], kc.shape[3]
+        kv_start = jnp.array([0, 23], jnp.int32)
+        for wi in (0, 64, T - S):  # first chunk, interior chunk, last chunk
+            kv_len = jnp.full((2,), wi + S, jnp.int32)
+            for lay in range(kc.shape[0]):
+                got = kernel(q, kc, vc, kv_start, kv_len, jnp.int32(lay),
+                             jnp.int32(wi), bq=32, bk=64, interpret=True)
+                want = oracle(q, kc, vc, kv_start, kv_len, jnp.int32(lay), jnp.int32(wi))
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+                )
+
+    def test_first_chunk_equals_flash_prefill(self):
+        """At write_index=0 with kv_len=S the chunked kernel must reproduce
+        plain causal prefill over the fresh K/V (written into the cache)."""
+        q, kc, vc, kernel, _ = self._problem(1, S=128)
+        B, S, H, hd = q.shape
+        K = kc.shape[2]
+        lay = 1
+        fresh_k = jax.random.normal(jax.random.PRNGKey(7), (B, S, K, hd))
+        fresh_v = jax.random.normal(jax.random.PRNGKey(8), (B, S, K, hd))
+        kc = kc.at[lay, :, :, :S].set(fresh_k.transpose(0, 2, 1, 3))
+        vc = vc.at[lay, :, :, :S].set(fresh_v.transpose(0, 2, 1, 3))
+        kv_start = jnp.array([0, 5], jnp.int32)
+        kv_len = jnp.full((B,), S, jnp.int32)
+        got = kernel(q, kc, vc, kv_start, kv_len, jnp.int32(lay), jnp.int32(0),
+                     bq=64, bk=64, interpret=True)
+        want = flash_attention(q, fresh_k, fresh_v, kv_start, kv_len,
+                               causal=True, bq=64, bk=64, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)

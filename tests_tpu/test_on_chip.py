@@ -75,6 +75,33 @@ class TestAttentionKernels:
                 np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
             )
 
+    def test_chunk_prefill_matches_oracle(self):
+        from rag_llm_k8s_tpu.ops.attention import (
+            chunk_attention_xla,
+            chunk_prefill_attention,
+        )
+
+        ks = jax.random.split(jax.random.PRNGKey(2), 3)
+        L, B, S, H, K, T, hd = 2, 2, 256, 8, 2, 1024, 128
+        q = jax.random.normal(ks[0], (B, S, H, hd), jnp.float32)
+        kc = jax.random.normal(ks[1], (L, B, K, T, hd), jnp.float32)
+        vc = jax.random.normal(ks[2], (L, B, K, T, hd), jnp.float32)
+        kv_start = jnp.array([0, 40], jnp.int32)
+        for wi in (0, 256, T - S):
+            kv_len = jnp.full((B,), wi + S, jnp.int32)
+            for lay in range(L):
+                with jax.default_matmul_precision("highest"):
+                    got = chunk_prefill_attention(
+                        q, kc, vc, kv_start, kv_len, jnp.int32(lay), jnp.int32(wi)
+                    )
+                    want = chunk_attention_xla(
+                        q, kc, vc, kv_start, kv_len, jnp.int32(lay), jnp.int32(wi)
+                    )
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+                )
+
+
 
 class TestEngineOnChip:
     def test_generate_pallas_vs_xla_logits_path(self):
