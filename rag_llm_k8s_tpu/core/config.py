@@ -252,6 +252,10 @@ class EngineConfig:
     # bucket-sized chunks (chunk_prefill_attention) up to this many tokens;
     # beyond it the engine truncates LOUDLY (logged), never silently
     max_chunked_prompt: int = 16384
+    # request scheduling: "continuous" = slot-based decode, requests join
+    # the running batch between steps (engine/continuous.py); "coalesce" =
+    # group compatible requests at start only (engine/batching.py)
+    batching: str = "continuous"
     # attention backend: "auto" = fused Pallas kernels on TPU, XLA einsum
     # oracle elsewhere (see models.llama.Attention)
     attn_impl: str = "auto"
@@ -336,4 +340,14 @@ class AppConfig:
             sampling = dataclasses.replace(
                 sampling, max_new_tokens=int(env["TPU_RAG_MAX_NEW_TOKENS"])
             )
-        return dataclasses.replace(cfg, server=server, mesh=mesh, sampling=sampling)
+        engine = cfg.engine
+        if "TPU_RAG_BATCHING" in env:
+            mode = env["TPU_RAG_BATCHING"]
+            if mode not in ("continuous", "coalesce"):
+                raise ValueError(
+                    f"TPU_RAG_BATCHING={mode!r}: expected 'continuous' or 'coalesce'"
+                )
+            engine = dataclasses.replace(engine, batching=mode)
+        return dataclasses.replace(
+            cfg, server=server, mesh=mesh, sampling=sampling, engine=engine
+        )

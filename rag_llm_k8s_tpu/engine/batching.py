@@ -73,8 +73,10 @@ class BatchScheduler:
 
     # ------------------------------------------------------------------
     def _run(self):
+        carry: Optional[_Pending] = None
         while not self._stop.is_set():
-            first = self._queue.get()
+            first = carry if carry is not None else self._queue.get()
+            carry = None
             if first is None:
                 continue
             batch = [first]
@@ -91,7 +93,10 @@ class BatchScheduler:
                 if nxt.max_new == first.max_new and nxt.seed == first.seed:
                     batch.append(nxt)
                 else:
-                    self._queue.put(nxt)  # different executable: next round
+                    # different executable: lead the NEXT round (a tail
+                    # re-queue would reorder it behind later arrivals and
+                    # could starve it under sustained mixed load)
+                    carry = nxt
                     break
             try:
                 outs = self.engine.generate(

@@ -1,0 +1,480 @@
+"""Continuous (slot-based) batching: requests join the running decode batch.
+
+The reference serves strictly sequentially — one ``model.generate`` at a
+time on a single-threaded Flask dev server (/root/reference/llm/rag.py:204);
+a request arriving mid-generation waits for the whole previous one. The
+coalescing ``BatchScheduler`` (engine/batching.py) improved that to
+group-at-start, but nothing could join a batch in flight.
+
+Here decoding runs over ``B`` persistent KV **slots** with per-row cache
+frontiers (``LlamaModel(row_frontier=True)``: each row's fed token is
+scatter-written at its own ``kv_len``), so rows at different generation
+depths decode together. Between device steps the scheduler admits waiting
+requests into free slots — a request arriving mid-generation starts decoding
+on the very next step instead of queueing behind the current batch.
+
+Anatomy (all AOT-compiled, static shapes):
+- ``_prefill(S)``: one B=1 forward over a bucketed prompt → that row's
+  ``[L, 1, K, S, hd]`` KV block + the first sampled token;
+- ``_insert(S)``: splice the KV block + per-row state into slot ``row``;
+- ``_step``: ONE decode token for all ``B`` slots (per-row windows mask
+  inactive/mismatched rows), returning tokens to the host — a ``B``-int
+  transfer per step, overlapped with the next admission check.
+
+Trade-off vs the fused one-shot path (engine.py): per-step host sync and a
+scatter cache write, in exchange for no head-of-line blocking. The one-shot
+path remains the fastest way to run a KNOWN batch (bench.py uses it).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rag_llm_k8s_tpu.core.config import (
+    DTypePolicy,
+    EngineConfig,
+    LlamaConfig,
+    SamplingConfig,
+)
+from rag_llm_k8s_tpu.core.mesh import MeshContext
+from rag_llm_k8s_tpu.engine.engine import _isin
+from rag_llm_k8s_tpu.engine.sampling import sample_token, sample_token_per_row
+from rag_llm_k8s_tpu.models.llama import LlamaModel, make_kv_cache, mask_window
+from rag_llm_k8s_tpu.utils.buckets import bucket_len
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Slot:
+    """Host-side view of one device slot."""
+
+    request_id: int = -1
+    tokens: List[int] = field(default_factory=list)
+    remaining: int = 0
+    active: bool = False
+
+
+class ContinuousEngine:
+    """Owns the persistent slot state on device; NOT thread-safe by itself —
+    the scheduler serializes all calls."""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params,
+        sampling: SamplingConfig = SamplingConfig(),
+        engine_config: EngineConfig = EngineConfig(),
+        dtypes: DTypePolicy = DTypePolicy(),
+        mesh: Optional[MeshContext] = None,
+        pad_id: int = 0,
+    ):
+        self.config = config
+        self.params = params
+        self.sampling = sampling
+        self.engine_config = engine_config
+        self.dtypes = dtypes
+        self.mesh = mesh
+        self.pad_id = pad_id
+        self.B = engine_config.max_batch_size
+        self.T = -(-engine_config.max_seq_len // 128) * 128
+        jmesh = mesh.mesh if mesh is not None and mesh.tp > 1 else None
+        self.model = LlamaModel(
+            config, dtypes, attn_impl=engine_config.attn_impl, mesh=jmesh
+        )
+        self.model_step = self.model.copy(row_frontier=True)
+        self._compiled: Dict[Tuple[str, int], jax.stages.Compiled] = {}
+        # ---- persistent device state -----------------------------------
+        cache = make_kv_cache(config, self.B, self.T, dtypes.compute_dtype)
+        self._cache_k, self._cache_v = cache.k, cache.v
+        self._kv_start = jnp.zeros((self.B,), jnp.int32)
+        self._kv_len = jnp.zeros((self.B,), jnp.int32)
+        self._last_tok = jnp.zeros((self.B,), jnp.int32)
+        self._active = jnp.zeros((self.B,), bool)
+        # per-row PRNG keys: a request's draws are keyed by its own seed and
+        # token position, so they do not depend on its batchmates (solo vs
+        # shared-batch runs of the same seeded request sample identically)
+        self._rng_keys = jnp.zeros((self.B, 2), jnp.uint32)
+        self._rng = jax.random.PRNGKey(sampling.seed)  # seedless-key stream
+        # ---- host-side bookkeeping -------------------------------------
+        self.slots = [_Slot() for _ in range(self.B)]
+        self.steps = 0  # global decode steps executed (tests/metrics)
+
+    def warmup(self, batch_sizes=None, buckets=None):
+        """AOT-compile every executable serving will hit (readiness gating);
+        ``batch_sizes`` is accepted for InferenceEngine API parity — slot
+        geometry is fixed at construction."""
+        for S in buckets or self.engine_config.prompt_buckets:
+            self._get("prefill", S)
+            self._get("insert", S)
+        self._get("step", 0)
+
+    def reset(self):
+        """Free every slot after a failed step: host bookkeeping clears and
+        device rows deactivate (their windows gate any stale cache)."""
+        self.slots = [_Slot() for _ in range(self.B)]
+        self._active = jnp.zeros((self.B,), bool)
+
+    # ------------------------------------------------------------------
+    # executables
+    # ------------------------------------------------------------------
+    def _get(self, kind: str, S: int):
+        key = (kind, S)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = {"prefill": self._build_prefill,
+                  "insert": self._build_insert,
+                  "step": self._build_step}[kind](S)
+            self._compiled[key] = fn
+        return fn
+
+    def _build_prefill(self, S: int):
+        cfg, dt, sampling = self.config, self.dtypes, self.sampling
+        model = self.model
+
+        def prefill(params, tokens, pad_mask, rng):
+            # B=1 single-shot prefill into a fresh S-length row cache
+            cache = make_kv_cache(cfg, 1, S, dt.compute_dtype)
+            kv_start, _ = mask_window(pad_mask)
+            positions = jnp.clip(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
+            logits, cache = model.apply(
+                {"params": params}, tokens, positions, cache,
+                kv_start, jnp.full((1,), S, jnp.int32), jnp.int32(0),
+                last_logit_only=True,
+            )
+            tok0 = sample_token(rng, logits[:, -1], sampling)[0]
+            return cache.k, cache.v, tok0, kv_start[0]
+
+        return jax.jit(prefill).lower(
+            self._param_avals(),
+            jax.ShapeDtypeStruct((1, S), jnp.int32),
+            jax.ShapeDtypeStruct((1, S), jnp.int32),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+        ).compile()
+
+    def _build_insert(self, S: int):
+        T = self.T
+
+        def insert(ck, cv, row_k, row_v, kv_start, kv_len, last_tok, active,
+                   rng_keys, row, row_start, tok0, row_key):
+            # the row's prompt KV occupies slots [0, S); frontiers are per-row
+            # so nothing else moves
+            ck = jax.lax.dynamic_update_slice(ck, row_k, (0, row, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, row_v, (0, row, 0, 0, 0))
+            kv_start = kv_start.at[row].set(row_start)
+            kv_len = kv_len.at[row].set(S)
+            last_tok = last_tok.at[row].set(tok0)
+            active = active.at[row].set(True)
+            rng_keys = rng_keys.at[row].set(row_key)
+            return ck, cv, kv_start, kv_len, last_tok, active, rng_keys
+
+        L, K, hd = self.config.num_layers, self.config.num_kv_heads, self.config.head_dim
+        cdt = self.dtypes.compute_dtype
+        i32 = jnp.int32
+        # row_k/row_v are not donated: a [L,1,K,S,hd] block cannot alias into
+        # the [L,B,K,T,hd] cache, so donation would only emit a warning
+        return jax.jit(insert, donate_argnums=(0, 1, 4, 5, 8)).lower(
+            jax.ShapeDtypeStruct((L, self.B, K, T, hd), cdt),
+            jax.ShapeDtypeStruct((L, self.B, K, T, hd), cdt),
+            jax.ShapeDtypeStruct((L, 1, K, S, hd), cdt),
+            jax.ShapeDtypeStruct((L, 1, K, S, hd), cdt),
+            jax.ShapeDtypeStruct((self.B,), i32),
+            jax.ShapeDtypeStruct((self.B,), i32),
+            jax.ShapeDtypeStruct((self.B,), i32),
+            jax.ShapeDtypeStruct((self.B,), bool),
+            jax.ShapeDtypeStruct((self.B, 2), jnp.uint32),
+            jax.ShapeDtypeStruct((), i32),
+            jax.ShapeDtypeStruct((), i32),
+            jax.ShapeDtypeStruct((), i32),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+        ).compile()
+
+    def _build_step(self, _unused: int = 0):
+        cfg, dt, sampling = self.config, self.dtypes, self.sampling
+        model = self.model_step
+        eos_ids = cfg.eos_token_ids
+        B, T = self.B, self.T
+
+        def step(params, ck, cv, kv_start, kv_len, last_tok, active, rng_keys):
+            wi = jnp.where(active, kv_len, 0)  # inactive rows park at slot 0
+            posv = jnp.clip(wi - kv_start, 0)  # inactive rows: junk, masked
+            from rag_llm_k8s_tpu.models.llama import KVCache
+
+            logits, cache = model.apply(
+                {"params": params}, last_tok[:, None], posv[:, None],
+                KVCache(k=ck, v=cv), kv_start, wi + 1, wi,
+            )
+            # key = fold(row seed key, token position): draws depend only on
+            # the request's own seed + position, never on batchmates — a
+            # seeded request samples identically solo or mid-batch
+            keys = jax.vmap(jax.random.fold_in)(rng_keys, posv + 1)
+            tok = sample_token_per_row(keys, logits[:, 0], sampling)
+            hit_eos = _isin(tok, eos_ids)
+            # frontier advances only for rows that were active this step and
+            # stays < T (the scheduler retires rows before they get close)
+            kv_len = jnp.where(active, jnp.minimum(wi + 1, T - 1), kv_len)
+            active = active & ~hit_eos
+            return cache.k, cache.v, kv_len, tok, hit_eos, active
+
+        i32 = jnp.int32
+        cdt = dt.compute_dtype
+        L, K, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+        # kv_start (3) and rng_keys (7) are NOT donated: neither is among the
+        # outputs, and the host keeps using their buffers across steps
+        return jax.jit(step, donate_argnums=(1, 2, 4, 5, 6)).lower(
+            self._param_avals(),
+            jax.ShapeDtypeStruct((L, B, K, T, hd), cdt),
+            jax.ShapeDtypeStruct((L, B, K, T, hd), cdt),
+            jax.ShapeDtypeStruct((B,), i32),
+            jax.ShapeDtypeStruct((B,), i32),
+            jax.ShapeDtypeStruct((B,), i32),
+            jax.ShapeDtypeStruct((B,), bool),
+            jax.ShapeDtypeStruct((B, 2), jnp.uint32),
+        ).compile()
+
+    def _param_avals(self):
+        return jax.tree.map(
+            lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=leaf.sharding)
+            if isinstance(leaf, jax.Array)
+            else jax.ShapeDtypeStruct(np.shape(leaf), np.asarray(leaf).dtype),
+            self.params,
+        )
+
+    # ------------------------------------------------------------------
+    # operations (called by the scheduler thread only)
+    # ------------------------------------------------------------------
+    def free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if not s.active]
+
+    def has_active(self) -> bool:
+        return any(s.active for s in self.slots)
+
+    def admit(
+        self,
+        request_id: int,
+        prompt: Sequence[int],
+        max_new: int,
+        seed: Optional[int] = None,
+    ) -> Tuple[int, Optional[List[int]]]:
+        """Prefill + insert into a free slot. Returns ``(slot, finished)``;
+        ``finished`` is the complete token list when the request ends at its
+        very first token (EOS or max_new=1) without occupying a slot.
+
+        The prompt is bucketed over the FULL bucket ladder and ``max_new`` is
+        clamped to the remaining cache room (mirroring
+        ``InferenceEngine._clamp_max_new``) — the prompt is never cut to make
+        room for generation. Only a prompt over the largest bucket truncates,
+        loudly (continuous slots are fixed-length; route such prompts through
+        ``InferenceEngine``'s chunked prefill instead)."""
+        free = self.free_slots()
+        assert free, "admit() without a free slot"
+        row = free[0]
+        buckets = tuple(b for b in self.engine_config.prompt_buckets if b < self.T)
+        S = bucket_len(max(len(prompt), 1), buckets)
+        max_new = max(1, min(max_new, self.T - S))
+        p = list(prompt)[-S:]
+        if len(prompt) > S:
+            logger.warning(
+                "continuous-batch prompt of %d tokens exceeds the largest "
+                "bucket %d; left-truncating", len(prompt), S,
+            )
+        tokens = np.full((1, S), self.pad_id, np.int32)
+        mask = np.zeros((1, S), np.int32)
+        tokens[0, S - len(p):] = p
+        mask[0, S - len(p):] = 1
+
+        if seed is not None:
+            row_key = jax.random.PRNGKey(seed)
+        else:
+            self._rng, row_key = jax.random.split(self._rng)
+        # position-indexed draw: the first sampled token sits at position
+        # len(p); decode steps continue the same fold sequence
+        row_k, row_v, tok0, row_start = self._get("prefill", S)(
+            self.params, jnp.asarray(tokens), jnp.asarray(mask),
+            jax.random.fold_in(row_key, len(p)),
+        )
+        tok0 = int(tok0)
+        if tok0 in self.config.eos_token_ids or max_new <= 1:
+            out = [] if tok0 in self.config.eos_token_ids else [tok0]
+            return row, out
+
+        (self._cache_k, self._cache_v, self._kv_start, self._kv_len,
+         self._last_tok, self._active, self._rng_keys) = self._get("insert", S)(
+            self._cache_k, self._cache_v, row_k, row_v,
+            self._kv_start, self._kv_len, self._last_tok, self._active,
+            self._rng_keys, jnp.int32(row), row_start, jnp.int32(tok0),
+            row_key,
+        )
+        self.slots[row] = _Slot(
+            request_id=request_id, tokens=[tok0], remaining=max_new - 1,
+            active=True,
+        )
+        return row, None
+
+    def step(self) -> List[Tuple[int, List[int]]]:
+        """One decode step for every active slot. Returns completed requests
+        as ``(request_id, tokens)`` and frees their slots."""
+        (self._cache_k, self._cache_v, self._kv_len, tok, hit_eos,
+         self._active) = self._get("step", 0)(
+            self.params, self._cache_k, self._cache_v, self._kv_start,
+            self._kv_len, self._last_tok, self._active, self._rng_keys,
+        )
+        self._last_tok = tok
+        self.steps += 1
+        tok_h = np.asarray(tok)
+        eos_h = np.asarray(hit_eos)
+        done: List[Tuple[int, List[int]]] = []
+        deactivate = []
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            finished = False
+            if eos_h[i]:
+                finished = True  # EOS token itself is not emitted
+            else:
+                slot.tokens.append(int(tok_h[i]))
+                slot.remaining -= 1
+                finished = slot.remaining <= 0
+            if finished:
+                done.append((slot.request_id, slot.tokens))
+                slot.active = False
+                deactivate.append(i)
+        if deactivate:
+            # rows that hit their budget (not EOS) must stop decoding on
+            # device too; EOS rows were already deactivated in-step
+            mask = np.ones(self.B, bool)
+            mask[deactivate] = False
+            self._active = self._active & jnp.asarray(mask)
+        return done
+
+
+class ContinuousScheduler:
+    """Thread-safe facade: ``submit()`` blocks the caller; a dispatcher
+    thread owns the engine, admitting between decode steps."""
+
+    def __init__(self, engine: ContinuousEngine, admit_wait_ms: float = 2.0):
+        self.engine = engine
+        self.admit_wait_ms = admit_wait_ms
+        self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
+        self._stop = threading.Event()
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name="continuous-scheduler"
+        )
+        self._worker.start()
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+        seed: Optional[int] = None,  # honored per-row: draws are seed+position keyed
+        timeout: Optional[float] = None,
+    ) -> List[int]:
+        if self._stop.is_set():
+            raise RuntimeError("scheduler is shut down")
+        max_new = (
+            self.engine.sampling.max_new_tokens
+            if max_new_tokens is None else max_new_tokens
+        )
+        if max_new <= 0:
+            return []
+        with self._id_lock:
+            self._next_id += 1
+            rid = self._next_id
+        item = _Pending(
+            request_id=rid, prompt=list(prompt), max_new=max_new, seed=seed
+        )
+        self._queue.put(item)
+        if not item.done.wait(timeout):
+            raise TimeoutError("generation timed out")
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def shutdown(self):
+        self._stop.set()
+        self._queue.put(None)
+        self._worker.join(timeout=5)
+
+    # ------------------------------------------------------------------
+    def _run(self):
+        eng = self.engine
+        waiting: Dict[int, _Pending] = {}
+        while not self._stop.is_set():
+            if eng.has_active():
+                # decode never waits on arrivals: peek, admit, step
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    item = None
+            else:
+                item = self._queue.get()  # idle: block until work arrives
+            while item is not None:  # admit everything that fits right now
+                if self._stop.is_set():
+                    return
+                try:
+                    if not eng.free_slots():
+                        # no room: decode until a slot frees, then admit
+                        self._safe_step(waiting)
+                        continue
+                    _, finished = eng.admit(
+                        item.request_id, item.prompt, item.max_new, item.seed
+                    )
+                    if finished is not None:
+                        item.result = finished
+                        item.done.set()
+                    else:
+                        waiting[item.request_id] = item
+                except BaseException as e:  # noqa: BLE001 — deliver to waiter
+                    item.error = e
+                    item.done.set()
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    item = None
+            if eng.has_active():
+                self._safe_step(waiting)
+
+    def _safe_step(self, waiting: Dict[int, "_Pending"]):
+        """One decode step that can never kill the dispatcher: a device error
+        fails every in-flight request (instead of hanging their callers
+        forever) and resets the slots so the loop keeps serving."""
+        try:
+            self._drain_done(self.engine.step(), waiting)
+        except BaseException as e:  # noqa: BLE001 — deliver, don't die
+            logger.exception(
+                "decode step failed; failing %d in-flight request(s)", len(waiting)
+            )
+            for item in waiting.values():
+                item.error = e
+                item.done.set()
+            waiting.clear()
+            self.engine.reset()
+
+    @staticmethod
+    def _drain_done(done, waiting):
+        for rid, tokens in done:
+            item = waiting.pop(rid, None)
+            if item is not None:
+                item.result = tokens
+                item.done.set()
+
+
+@dataclass
+class _Pending:
+    request_id: int
+    prompt: List[int]
+    max_new: int
+    seed: Optional[int] = None
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[List[int]] = None
+    error: Optional[BaseException] = None

@@ -45,3 +45,22 @@ def sample_token(
     if sampling.top_p < 1.0:
         scaled = top_p_filter(scaled, sampling.top_p)
     return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+
+
+def sample_token_per_row(
+    keys: jax.Array,  # [B, 2] uint32 — one PRNGKey per row
+    logits: jax.Array,  # [B, V] fp32
+    sampling: SamplingConfig,
+) -> jax.Array:
+    """Per-row-keyed sampling step -> token ids ``[B]`` (int32).
+
+    Continuous batching needs independent randomness per slot: rows carry
+    their own keys so a request's draws don't depend on its batchmates."""
+    if not sampling.do_sample or sampling.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / sampling.temperature
+    if sampling.top_p < 1.0:
+        scaled = top_p_filter(scaled, sampling.top_p)
+    return jax.vmap(
+        lambda k, row: jax.random.categorical(k, row, axis=-1)
+    )(keys, scaled).astype(jnp.int32)

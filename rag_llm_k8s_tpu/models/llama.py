@@ -174,6 +174,12 @@ class Attention(nn.Module):
     # K/V — the engine builds a separate model instance with chunked=True
     # for its long-prompt executables, so tracing never inspects write_index
     chunked: bool = False
+    # STATIC per-row-frontier switch (continuous batching): decode calls take
+    # write_index as a [B] vector — every row writes its fed token at its OWN
+    # cache frontier (scatter), so rows at different generation depths share
+    # one batch. The per-row [kv_start, kv_len) windows already handle the
+    # masking; only the cache write changes.
+    row_frontier: bool = False
 
     def _resolved_impl(self) -> str:
         if self.attn_impl not in ("auto", "pallas", "pallas_interpret", "xla"):
@@ -300,16 +306,28 @@ class Attention(nn.Module):
         # layers and decode steps — no cache-sized copy ever happens (the
         # naive per-layer-output stacking costs GB/step of pure copy traffic)
         k_cache, v_cache = kv  # [L, B, K, T, hd]
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache,
-            k.transpose(0, 2, 1, 3).astype(k_cache.dtype)[None],
-            (layer, 0, 0, write_index, 0),
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache,
-            v.transpose(0, 2, 1, 3).astype(v_cache.dtype)[None],
-            (layer, 0, 0, write_index, 0),
-        )
+        if self.row_frontier and S == 1:
+            # continuous batching: write_index is [B] — each row's token
+            # lands at that row's own frontier (one-slot-per-row scatter,
+            # aliased in place under the scan carry like the slice write)
+            b_idx = jnp.arange(B)
+            k_cache = k_cache.at[layer, b_idx, :, write_index, :].set(
+                k[:, 0].astype(k_cache.dtype)
+            )
+            v_cache = v_cache.at[layer, b_idx, :, write_index, :].set(
+                v[:, 0].astype(v_cache.dtype)
+            )
+        else:
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache,
+                k.transpose(0, 2, 1, 3).astype(k_cache.dtype)[None],
+                (layer, 0, 0, write_index, 0),
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache,
+                v.transpose(0, 2, 1, 3).astype(v_cache.dtype)[None],
+                (layer, 0, 0, write_index, 0),
+            )
 
         if S == 1:
             out = self._attend(q, k_cache, v_cache, kv_start, kv_len, layer, mode="decode")
@@ -364,13 +382,14 @@ class Block(nn.Module):
     attn_impl: str = "auto"
     mesh: Optional[Mesh] = None
     chunked: bool = False
+    row_frontier: bool = False
 
     @nn.compact
     def __call__(self, carry, kv_start, kv_len, cos, sin, write_index):
         h, kv, layer = carry
         attn_out, kv = Attention(
             self.config, self.dtypes, self.attn_impl, self.mesh, self.chunked,
-            name="attn",
+            self.row_frontier, name="attn",
         )(
             RMSNorm(self.config.rms_norm_eps, self.dtypes, name="input_norm")(h),
             kv, layer, kv_start, kv_len, cos, sin, write_index,
@@ -403,6 +422,7 @@ class LlamaModel(nn.Module):
     attn_impl: str = "auto"  # see Attention.attn_impl ("xla" = differentiable)
     mesh: Optional[Mesh] = None
     chunked: bool = False  # see Attention.chunked (long-prompt prefill)
+    row_frontier: bool = False  # see Attention.row_frontier (continuous batching)
 
     @nn.compact
     def __call__(
@@ -435,7 +455,8 @@ class LlamaModel(nn.Module):
             length=c.num_layers,
         )
         (h, (new_k, new_v), _), _ = ScanBlocks(
-            c, dt, self.attn_impl, self.mesh, self.chunked, name="layers"
+            c, dt, self.attn_impl, self.mesh, self.chunked, self.row_frontier,
+            name="layers",
         )(
             (h, (cache.k, cache.v), jnp.int32(0)), kv_start, kv_len, cos, sin, write_index
         )

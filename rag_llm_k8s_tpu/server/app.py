@@ -220,7 +220,13 @@ class RagService:
         buckets warm — RAG prompts with a full 3-chunk context land in the
         largest bucket, so warming only small buckets would leave the very
         first production query paying the big compile."""
-        self.engine.warmup(batch_sizes=(1,), buckets=self.engine.engine_config.prompt_buckets)
+        # warm the engine that actually serves: the scheduler's (continuous
+        # slots or coalescing wrapper around self.engine); self.engine alone
+        # only when no scheduler exists
+        serving_engine = self.scheduler.engine if self.scheduler is not None else self.engine
+        serving_engine.warmup(
+            batch_sizes=(1,), buckets=serving_engine.engine_config.prompt_buckets
+        )
         self.embed_texts(["warmup"])
         self.ready = True
 

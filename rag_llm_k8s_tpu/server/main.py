@@ -112,9 +112,21 @@ def build_service():
         config.server.index_path, dim=config.retrieval.embed_dim, fingerprint=fingerprint
     )
 
-    from rag_llm_k8s_tpu.engine.batching import BatchScheduler
+    if config.engine.batching == "continuous":
+        from rag_llm_k8s_tpu.engine.continuous import (
+            ContinuousEngine,
+            ContinuousScheduler,
+        )
 
-    scheduler = BatchScheduler(engine)
+        cont = ContinuousEngine(
+            model_cfg, params, sampling=config.sampling,
+            engine_config=config.engine, dtypes=config.dtypes, mesh=mesh,
+        )
+        scheduler = ContinuousScheduler(cont)
+    else:
+        from rag_llm_k8s_tpu.engine.batching import BatchScheduler
+
+        scheduler = BatchScheduler(engine)
     return RagService(
         config, engine, llm_tokenizer, encoder, enc_tokenizer, store, scheduler=scheduler
     )

@@ -61,3 +61,43 @@ class TestBatchScheduler:
         sched.shutdown()
         with pytest.raises(RuntimeError):
             sched.submit([1, 2, 3])
+
+
+class TestNoReorderOnMismatch:
+    def test_worker_never_requeues_drained_items(self, engine):
+        """The carry fix means a mismatched request is held as next round's
+        leader, NEVER put back on the queue (a tail re-queue would reorder
+        it behind requests that arrived later and could starve it under
+        sustained mixed load). Detect any worker-thread re-put directly."""
+        import time
+
+        sched = BatchScheduler(engine, max_wait_ms=100.0)
+        try:
+            worker_puts = []
+            orig_put = sched._queue.put
+
+            def spy_put(item, *a, **kw):
+                if threading.current_thread() is sched._worker:
+                    worker_puts.append(item)
+                return orig_put(item, *a, **kw)
+
+            sched._queue.put = spy_put
+
+            outs = {}
+
+            def run(name, max_new):
+                outs[name] = sched.submit([3, 17], max_new_tokens=max_new, timeout=120)
+
+            # a leads round 1; b (different executable key) is drained during
+            # a's coalescing window and must be carried, not re-queued
+            ta = threading.Thread(target=run, args=("a", 4))
+            ta.start()
+            time.sleep(0.02)  # worker is now inside a's drain window
+            tb = threading.Thread(target=run, args=("b", 5))
+            tb.start()
+            ta.join(timeout=120)
+            tb.join(timeout=120)
+            assert set(outs) == {"a", "b"} and all(outs.values())
+            assert worker_puts == []  # the old behavior re-put b here
+        finally:
+            sched.shutdown()

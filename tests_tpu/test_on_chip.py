@@ -173,3 +173,36 @@ class TestEngineOnChip:
         assert out1 == out2  # greedy determinism through the kernel path
         assert all(len(o) <= 8 for o in out1)
         assert all(t not in cfg.eos_token_ids for o in out1 for t in o)
+
+
+class TestContinuousOnChip:
+    def test_mid_flight_admission_parity(self):
+        """Slot-based decode on real hardware: scatter cache writes + fused
+        decode kernel produce the one-shot engine's greedy tokens, including
+        for a request admitted mid-generation."""
+        from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine
+        from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+        from rag_llm_k8s_tpu.models.llama import init_llama_params
+
+        DT = DTypePolicy()  # production bf16 policy
+        cfg = LlamaConfig.tiny()
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        greedy = SamplingConfig(do_sample=False, max_new_tokens=8)
+        ecfg = EngineConfig(prompt_buckets=(16, 32), max_batch_size=4, max_seq_len=64)
+        oracle = InferenceEngine(cfg, params, sampling=greedy, engine_config=ecfg, dtypes=DT)
+        eng = ContinuousEngine(cfg, params, sampling=greedy, engine_config=ecfg, dtypes=DT)
+
+        p1, p2 = [3, 17, 42, 7, 99], [5, 5, 8]
+        want1 = oracle.generate([p1])[0]
+        want2 = oracle.generate([p2])[0]
+        eng.admit(1, p1, greedy.max_new_tokens)
+        results = {}
+        for _ in range(3):
+            for rid, toks in eng.step():
+                results[rid] = toks
+        eng.admit(2, p2, greedy.max_new_tokens)
+        while eng.has_active():
+            for rid, toks in eng.step():
+                results[rid] = toks
+        assert results[1] == want1
+        assert results[2] == want2
