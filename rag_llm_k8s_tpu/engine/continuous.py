@@ -45,7 +45,7 @@ from rag_llm_k8s_tpu.core.config import (
     SamplingConfig,
 )
 from rag_llm_k8s_tpu.core.mesh import MeshContext
-from rag_llm_k8s_tpu.engine.engine import _isin
+from rag_llm_k8s_tpu.engine.engine import EngineStats, _isin
 from rag_llm_k8s_tpu.engine.sampling import sample_token, sample_token_per_row
 from rag_llm_k8s_tpu.models.llama import LlamaModel, make_kv_cache, mask_window
 from rag_llm_k8s_tpu.utils.buckets import bucket_len
@@ -86,6 +86,16 @@ class ContinuousEngine:
         self.pad_id = pad_id
         self.B = engine_config.max_batch_size
         self.T = -(-engine_config.max_seq_len // 128) * 128
+        # only buckets that leave decode room fit a slot; an empty ladder is
+        # a config error — fail at construction, not per-request
+        self.buckets = tuple(
+            b for b in engine_config.prompt_buckets if b < self.T
+        )
+        if not self.buckets:
+            raise ValueError(
+                f"no prompt bucket in {engine_config.prompt_buckets} fits "
+                f"max_seq_len={engine_config.max_seq_len} (slot length {self.T})"
+            )
         jmesh = mesh.mesh if mesh is not None and mesh.tp > 1 else None
         self.model = LlamaModel(
             config, dtypes, attn_impl=engine_config.attn_impl, mesh=jmesh
@@ -107,21 +117,33 @@ class ContinuousEngine:
         # ---- host-side bookkeeping -------------------------------------
         self.slots = [_Slot() for _ in range(self.B)]
         self.steps = 0  # global decode steps executed (tests/metrics)
+        self.stats = EngineStats()  # /metrics parity with InferenceEngine
 
     def warmup(self, batch_sizes=None, buckets=None):
         """AOT-compile every executable serving will hit (readiness gating);
         ``batch_sizes`` is accepted for InferenceEngine API parity — slot
         geometry is fixed at construction."""
-        for S in buckets or self.engine_config.prompt_buckets:
+        for S in buckets or self.buckets:
+            if S not in self.buckets:
+                continue  # admit can never use a bucket without decode room
             self._get("prefill", S)
             self._get("insert", S)
         self._get("step", 0)
 
     def reset(self):
-        """Free every slot after a failed step: host bookkeeping clears and
-        device rows deactivate (their windows gate any stale cache)."""
+        """Rebuild ALL device state after a failed step. A step that dies
+        during device execution has already invalidated its DONATED inputs
+        (cache, kv_len, last_tok, active) — merely deactivating slots would
+        leave the next admit holding deleted arrays, bricking the engine
+        while /healthz still reports ready."""
         self.slots = [_Slot() for _ in range(self.B)]
+        cache = make_kv_cache(self.config, self.B, self.T, self.dtypes.compute_dtype)
+        self._cache_k, self._cache_v = cache.k, cache.v
+        self._kv_start = jnp.zeros((self.B,), jnp.int32)
+        self._kv_len = jnp.zeros((self.B,), jnp.int32)
+        self._last_tok = jnp.zeros((self.B,), jnp.int32)
         self._active = jnp.zeros((self.B,), bool)
+        self._rng_keys = jnp.zeros((self.B, 2), jnp.uint32)
 
     # ------------------------------------------------------------------
     # executables
@@ -277,8 +299,7 @@ class ContinuousEngine:
         free = self.free_slots()
         assert free, "admit() without a free slot"
         row = free[0]
-        buckets = tuple(b for b in self.engine_config.prompt_buckets if b < self.T)
-        S = bucket_len(max(len(prompt), 1), buckets)
+        S = bucket_len(max(len(prompt), 1), self.buckets)
         max_new = max(1, min(max_new, self.T - S))
         p = list(prompt)[-S:]
         if len(prompt) > S:
@@ -302,8 +323,11 @@ class ContinuousEngine:
             jax.random.fold_in(row_key, len(p)),
         )
         tok0 = int(tok0)
+        self.stats.generate_calls += 1
+        self.stats.prefill_tokens += len(p)
         if tok0 in self.config.eos_token_ids or max_new <= 1:
             out = [] if tok0 in self.config.eos_token_ids else [tok0]
+            self.stats.decode_tokens += len(out)
             return row, out
 
         (self._cache_k, self._cache_v, self._kv_start, self._kv_len,
@@ -317,6 +341,7 @@ class ContinuousEngine:
             request_id=request_id, tokens=[tok0], remaining=max_new - 1,
             active=True,
         )
+        self.stats.decode_tokens += 1  # tok0, sampled at prefill
         return row, None
 
     def step(self) -> List[Tuple[int, List[int]]]:
@@ -342,6 +367,7 @@ class ContinuousEngine:
             else:
                 slot.tokens.append(int(tok_h[i]))
                 slot.remaining -= 1
+                self.stats.decode_tokens += 1
                 finished = slot.remaining <= 0
             if finished:
                 done.append((slot.request_id, slot.tokens))
@@ -360,9 +386,8 @@ class ContinuousScheduler:
     """Thread-safe facade: ``submit()`` blocks the caller; a dispatcher
     thread owns the engine, admitting between decode steps."""
 
-    def __init__(self, engine: ContinuousEngine, admit_wait_ms: float = 2.0):
+    def __init__(self, engine: ContinuousEngine):
         self.engine = engine
-        self.admit_wait_ms = admit_wait_ms
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
         self._stop = threading.Event()
         self._next_id = 0
@@ -407,8 +432,33 @@ class ContinuousScheduler:
 
     # ------------------------------------------------------------------
     def _run(self):
-        eng = self.engine
         waiting: Dict[int, _Pending] = {}
+        item: Optional[_Pending] = None
+        try:
+            item = self._run_loop(waiting)
+        finally:
+            # fail everything still in flight or queued so no caller blocks
+            # forever on a scheduler that has stopped (answer() submits with
+            # timeout=None)
+            err = RuntimeError("scheduler is shut down")
+            leftovers = list(waiting.values())
+            waiting.clear()
+            if item is not None:
+                leftovers.append(item)
+            while True:
+                try:
+                    queued = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if queued is not None:
+                    leftovers.append(queued)
+            for it in leftovers:
+                it.error = err
+                it.done.set()
+
+    def _run_loop(self, waiting: Dict[int, "_Pending"]) -> Optional["_Pending"]:
+        """Returns the un-acked in-hand item (if any) when stopping."""
+        eng = self.engine
         while not self._stop.is_set():
             if eng.has_active():
                 # decode never waits on arrivals: peek, admit, step
@@ -420,7 +470,7 @@ class ContinuousScheduler:
                 item = self._queue.get()  # idle: block until work arrives
             while item is not None:  # admit everything that fits right now
                 if self._stop.is_set():
-                    return
+                    return item  # un-acked: the finally drain fails it
                 try:
                     if not eng.free_slots():
                         # no room: decode until a slot frees, then admit
@@ -443,6 +493,7 @@ class ContinuousScheduler:
                     item = None
             if eng.has_active():
                 self._safe_step(waiting)
+        return None
 
     def _safe_step(self, waiting: Dict[int, "_Pending"]):
         """One decode step that can never kill the dispatcher: a device error

@@ -33,17 +33,26 @@ def top_p_filter(logits: jax.Array, top_p: float) -> jax.Array:
     return jnp.where(logits >= threshold, logits, NEG_INF)
 
 
+def _prepared_logits(logits: jax.Array, sampling: SamplingConfig):
+    """Shared pipeline: ``None`` means greedy (argmax), otherwise the
+    temperature-scaled, nucleus-filtered logits to draw from."""
+    if not sampling.do_sample or sampling.temperature <= 0.0:
+        return None
+    scaled = logits / sampling.temperature
+    if sampling.top_p < 1.0:
+        scaled = top_p_filter(scaled, sampling.top_p)
+    return scaled
+
+
 def sample_token(
     rng: jax.Array,
     logits: jax.Array,  # [B, V] fp32
     sampling: SamplingConfig,
 ) -> jax.Array:
     """One sampling step -> token ids ``[B]`` (int32)."""
-    if not sampling.do_sample or sampling.temperature <= 0.0:
+    scaled = _prepared_logits(logits, sampling)
+    if scaled is None:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits / sampling.temperature
-    if sampling.top_p < 1.0:
-        scaled = top_p_filter(scaled, sampling.top_p)
     return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
 
 
@@ -56,11 +65,9 @@ def sample_token_per_row(
 
     Continuous batching needs independent randomness per slot: rows carry
     their own keys so a request's draws don't depend on its batchmates."""
-    if not sampling.do_sample or sampling.temperature <= 0.0:
+    scaled = _prepared_logits(logits, sampling)
+    if scaled is None:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits / sampling.temperature
-    if sampling.top_p < 1.0:
-        scaled = top_p_filter(scaled, sampling.top_p)
     return jax.vmap(
         lambda k, row: jax.random.categorical(k, row, axis=-1)
     )(keys, scaled).astype(jnp.int32)

@@ -307,7 +307,11 @@ class WsgiApp:
 
     def ep_metrics(self, request):
         snap = self.service.metrics.snapshot()
-        stats = self.service.engine.stats
+        # counters must come from the engine that serves traffic — the
+        # scheduler's (continuous or coalescing), not the idle one-shot one
+        svc = self.service
+        serving = svc.scheduler.engine if svc.scheduler is not None else svc.engine
+        stats = serving.stats
         snap.update(
             {
                 "engine_generate_calls": stats.generate_calls,

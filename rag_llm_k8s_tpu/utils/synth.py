@@ -1,0 +1,92 @@
+"""Synthetic HF-layout checkpoint writer (validation / benchmarks).
+
+Writes a ``model-0000X-of-0000N.safetensors`` shard set with EXACTLY the
+tensor names, dtypes and shapes of a real HF Llama checkpoint — the same
+on-disk surface ``download_model.py`` stages into the model PVC
+(/root/reference/llm/download_model.py:14-25) — so the streaming loader
+(`models/loader.py`) and TP placement (`parallel/sharding.py`) can be proven
+at true 8B geometry without the 16 GB download this environment cannot make
+(zero egress). Tensors are zero-filled: the proof targets memory behavior,
+dtype handling and sharding math, not numerics (covered by the tiny
+round-trip parity tests).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from rag_llm_k8s_tpu.core.config import LlamaConfig
+
+
+def llama_tensor_specs(config: LlamaConfig) -> List[Tuple[str, Tuple[int, ...]]]:
+    """(hf_name, shape) for every tensor of a Llama checkpoint, in the
+    embed → layers → norm/lm_head order real shard indexes follow."""
+    D, I = config.hidden_size, config.intermediate_size
+    H, K, hd, V = config.num_heads, config.num_kv_heads, config.head_dim, config.vocab_size
+    specs: List[Tuple[str, Tuple[int, ...]]] = [
+        ("model.embed_tokens.weight", (V, D)),
+    ]
+    for i in range(config.num_layers):
+        p = f"model.layers.{i}."
+        specs += [
+            (p + "self_attn.q_proj.weight", (H * hd, D)),
+            (p + "self_attn.k_proj.weight", (K * hd, D)),
+            (p + "self_attn.v_proj.weight", (K * hd, D)),
+            (p + "self_attn.o_proj.weight", (D, H * hd)),
+            (p + "mlp.gate_proj.weight", (I, D)),
+            (p + "mlp.up_proj.weight", (I, D)),
+            (p + "mlp.down_proj.weight", (D, I)),
+            (p + "input_layernorm.weight", (D,)),
+            (p + "post_attention_layernorm.weight", (D,)),
+        ]
+    specs.append(("model.norm.weight", (D,)))
+    if not config.tie_word_embeddings:
+        specs.append(("lm_head.weight", (V, D)))
+    return specs
+
+
+def write_synth_checkpoint(
+    out_dir: str,
+    config: LlamaConfig,
+    n_shards: int = 4,
+    dtype=None,
+) -> List[str]:
+    """Write a zero-filled ``n_shards``-file safetensors checkpoint for
+    ``config`` (default dtype: bfloat16, like the staged Meta weights).
+    Tensors are assigned to shards by cumulative byte budget, matching how
+    real HF shard indexes split a model. Returns the shard paths."""
+    import ml_dtypes
+    from safetensors.numpy import save_file
+
+    dtype = np.dtype(ml_dtypes.bfloat16) if dtype is None else np.dtype(dtype)
+    specs = llama_tensor_specs(config)
+    total = sum(int(np.prod(s)) * dtype.itemsize for _, s in specs)
+    budget = -(-total // n_shards)
+
+    os.makedirs(out_dir, exist_ok=True)
+    paths: List[str] = []
+    shard: Dict[str, np.ndarray] = {}
+    used, shard_i = 0, 1
+
+    def flush():
+        nonlocal shard, used, shard_i
+        if not shard:
+            return
+        path = os.path.join(
+            out_dir, f"model-{shard_i:05d}-of-{n_shards:05d}.safetensors"
+        )
+        save_file(shard, path)
+        paths.append(path)
+        shard, used, shard_i = {}, 0, shard_i + 1
+
+    for name, shape in specs:
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        if shard and used + nbytes > budget and shard_i < n_shards:
+            flush()
+        shard[name] = np.zeros(shape, dtype)
+        used += nbytes
+    flush()
+    return paths

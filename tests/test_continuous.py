@@ -236,3 +236,65 @@ class TestDispatcherSurvivesStepFailure:
             assert isinstance(out, list) and out
         finally:
             sched.shutdown()
+
+
+class TestResetRebuildsDeviceState:
+    def test_recovery_after_donated_buffers_invalidated(self, setup):
+        """A step failing DURING device execution has already consumed its
+        donated inputs (cache, kv_len, last_tok, active). reset() must
+        rebuild them, or the engine serves 'Array has been deleted' forever
+        while reporting healthy."""
+        cfg, params, _ = setup
+        eng = make_engine(cfg, params)
+        _, fin = eng.admit(1, [3, 17, 42], GREEDY.max_new_tokens)
+        assert fin is None
+        eng.step()
+        # simulate the donation outcome of a mid-execution failure
+        for buf in (eng._cache_k, eng._cache_v, eng._kv_len,
+                    eng._last_tok, eng._active):
+            buf.delete()
+        eng.reset()
+        # the engine must serve again, correctly
+        oracle = InferenceEngine(
+            cfg, params, sampling=GREEDY, engine_config=ENG_CFG, dtypes=FP32
+        )
+        want = oracle.generate([[5, 5, 8]])[0]
+        _, fin = eng.admit(2, [5, 5, 8], GREEDY.max_new_tokens)
+        assert fin is None
+        results = {}
+        while eng.has_active():
+            for rid, toks in eng.step():
+                results[rid] = toks
+        assert results[2] == want
+
+
+class TestShutdownDrainsWaiters:
+    def test_inflight_callers_unblock_on_shutdown(self, setup):
+        """shutdown() while requests are mid-generation must error them out,
+        not leave timeout=None callers blocked forever."""
+        cfg, params, _ = setup
+        eng = ContinuousEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=2000),
+            engine_config=EngineConfig(
+                prompt_buckets=(16,), max_batch_size=4, max_seq_len=2048
+            ),
+            dtypes=FP32,
+        )
+        sched = ContinuousScheduler(eng)
+        errors = []
+
+        def run():
+            try:
+                sched.submit([3, 17, 42], timeout=None)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        while eng.steps < 2:  # definitely mid-generation
+            time.sleep(0.01)
+        sched.shutdown()
+        t.join(timeout=30)
+        assert not t.is_alive(), "caller still blocked after shutdown"
+        assert errors and "shut down" in str(errors[0])

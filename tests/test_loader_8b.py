@@ -1,0 +1,117 @@
+"""8B-geometry streaming-load proof (CI-sized).
+
+The reference serves Meta-Llama-3.1-8B from a 4-shard safetensors layout
+(/root/reference/llm/download_model.py:14-25). These tests prove the
+framework's streaming loader + TP placement at TRUE 8B tensor shapes —
+hidden 4096, intermediate 14336, 32 q / 8 kv heads, vocab 128 256, bf16 on
+disk — with the layer count reduced to 2 so CI stays fast (the streaming
+claim is exactly that host memory does NOT scale with layer count; the
+full-depth run lives in scripts/validate_8b.py, results in docs/8B.md).
+"""
+
+import dataclasses
+import os
+import resource
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import psutil
+import pytest
+
+from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
+from rag_llm_k8s_tpu.models.loader import load_safetensors_params
+from rag_llm_k8s_tpu.parallel.sharding import make_streaming_put
+from rag_llm_k8s_tpu.utils.synth import write_synth_checkpoint
+
+CFG_8B_L2 = dataclasses.replace(LlamaConfig.llama_3_1_8b(), num_layers=2)
+GB = 1 << 30
+
+
+@pytest.fixture(scope="module")
+def synth_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("synth8b")
+    paths = write_synth_checkpoint(str(out), CFG_8B_L2, n_shards=4)
+    assert len(paths) == 4  # the real PVC layout: 4 shard files
+    return str(out)
+
+
+class TestStreaming8B:
+    def test_tp_streamed_load_shapes_shardings_and_memory(self, synth_dir, mesh_tp8):
+        """Stream the 4-shard checkpoint onto the 8-device mesh: every tensor
+        must arrive TP-sharded at true 8B shapes in bf16, with transient host
+        overhead bounded by a couple of single tensors — NOT the checkpoint
+        size (the reference's from_pretrained materializes the whole model)."""
+        ckpt_bytes = sum(
+            os.path.getsize(os.path.join(synth_dir, f))
+            for f in os.listdir(synth_dir)
+        )
+        assert ckpt_bytes > 2 * GB  # true-shape sanity: L=2 slice is ~3 GB
+
+        proc = psutil.Process()
+        put = make_streaming_put(mesh_tp8, dtype=jnp.bfloat16)
+        params = load_safetensors_params(
+            synth_dir, CFG_8B_L2, DTypePolicy(), put=put
+        )
+        rss_after = proc.memory_info().rss
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+        # ---- geometry: stacked [L, ...] at true 8B shapes, bf16 ----------
+        c = CFG_8B_L2
+        lay = params["layers"]
+        assert params["embedding"].shape == (c.vocab_size, c.hidden_size)
+        assert lay["attn"]["wq"]["kernel"].shape == (
+            2, c.hidden_size, c.num_heads * c.head_dim
+        )
+        assert lay["attn"]["wk"]["kernel"].shape == (
+            2, c.hidden_size, c.num_kv_heads * c.head_dim
+        )
+        assert lay["mlp"]["w_gate"]["kernel"].shape == (
+            2, c.hidden_size, c.intermediate_size
+        )
+        assert params["lm_head"].shape == (c.hidden_size, c.vocab_size)
+        assert params["embedding"].dtype == jnp.bfloat16
+        assert lay["mlp"]["w_gate"]["kernel"].dtype == jnp.bfloat16
+
+        # ---- sharding: the big matmuls actually split over tp=8 ----------
+        for leaf in (
+            lay["attn"]["wq"]["kernel"],
+            lay["mlp"]["w_gate"]["kernel"],
+            params["lm_head"],
+        ):
+            shard_bytes = leaf.addressable_shards[0].data.nbytes
+            assert shard_bytes * 8 == leaf.nbytes, leaf.sharding
+
+        # ---- memory: transient overhead, not checkpoint-sized ------------
+        # on the CPU mesh the PLACED params necessarily stay resident in
+        # host RAM (they'd leave for HBM on real chips), so the streaming
+        # claim is about the TRANSIENT above the final resident set: at most
+        # a couple of vocab-sized tensors (embed read + lm_head transpose),
+        # never the multi-GB whole-checkpoint spike from_pretrained makes.
+        embed_bytes = c.vocab_size * c.hidden_size * 2
+        transient = peak - rss_after
+        assert transient < 3 * embed_bytes + 512 * (1 << 20), (
+            f"transient host overhead {transient / GB:.2f} GB suggests the "
+            f"loader materialized more than a streamed group"
+        )
+
+    def test_loaded_tree_runs_a_forward(self, synth_dir, mesh_tp8):
+        """The placed 8B-shaped tree must actually execute one sharded
+        forward step (zero weights → finite zero logits)."""
+        from rag_llm_k8s_tpu.models.llama import LlamaModel, make_kv_cache
+
+        put = make_streaming_put(mesh_tp8, dtype=jnp.bfloat16)
+        params = load_safetensors_params(
+            synth_dir, CFG_8B_L2, DTypePolicy(), put=put
+        )
+        model = LlamaModel(CFG_8B_L2, DTypePolicy(), attn_impl="xla")
+        B, S = 1, 8
+        cache = make_kv_cache(CFG_8B_L2, B, 128, jnp.bfloat16)
+        logits, _ = jax.jit(
+            lambda p, t: model.apply(
+                {"params": p}, t, jnp.broadcast_to(jnp.arange(S), (B, S)),
+                cache, jnp.zeros((B,), jnp.int32), jnp.full((B,), S, jnp.int32),
+                jnp.int32(0), last_logit_only=True,
+            )
+        )(params, jnp.ones((B, S), jnp.int32))
+        assert np.isfinite(np.asarray(logits)).all()
