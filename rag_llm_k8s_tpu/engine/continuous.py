@@ -53,6 +53,11 @@ from rag_llm_k8s_tpu.utils.buckets import bucket_len
 logger = logging.getLogger(__name__)
 
 
+class EngineStateLost(RuntimeError):
+    """A device failure invalidated donated engine buffers; the engine has
+    been reset and every request that was in flight is gone."""
+
+
 @dataclass
 class _Slot:
     """Host-side view of one device slot."""
@@ -330,13 +335,21 @@ class ContinuousEngine:
             self.stats.decode_tokens += len(out)
             return row, out
 
-        (self._cache_k, self._cache_v, self._kv_start, self._kv_len,
-         self._last_tok, self._active, self._rng_keys) = self._get("insert", S)(
-            self._cache_k, self._cache_v, row_k, row_v,
-            self._kv_start, self._kv_len, self._last_tok, self._active,
-            self._rng_keys, jnp.int32(row), row_start, jnp.int32(tok0),
-            row_key,
-        )
+        try:
+            (self._cache_k, self._cache_v, self._kv_start, self._kv_len,
+             self._last_tok, self._active, self._rng_keys) = self._get("insert", S)(
+                self._cache_k, self._cache_v, row_k, row_v,
+                self._kv_start, self._kv_len, self._last_tok, self._active,
+                self._rng_keys, jnp.int32(row), row_start, jnp.int32(tok0),
+                row_key,
+            )
+        except BaseException as e:  # noqa: BLE001
+            # insert donates the engine's cache/state buffers: a failure
+            # mid-execution has invalidated them even though nothing was
+            # reassigned — rebuild now, or every later admit serves
+            # "Array has been deleted" while /healthz stays green
+            self.reset()
+            raise EngineStateLost("insert failed; engine state reset") from e
         self.slots[row] = _Slot(
             request_id=request_id, tokens=[tok0], remaining=max_new - 1,
             active=True,
@@ -392,6 +405,10 @@ class ContinuousScheduler:
         self._stop = threading.Event()
         self._next_id = 0
         self._id_lock = threading.Lock()
+        # serializes the stop-check+enqueue in submit() against shutdown()'s
+        # final drain — without it an item can land in the queue after the
+        # drain and block its caller forever
+        self._lifecycle_lock = threading.Lock()
         self._worker = threading.Thread(
             target=self._run, daemon=True, name="continuous-scheduler"
         )
@@ -418,7 +435,10 @@ class ContinuousScheduler:
         item = _Pending(
             request_id=rid, prompt=list(prompt), max_new=max_new, seed=seed
         )
-        self._queue.put(item)
+        with self._lifecycle_lock:  # stop-check + enqueue must be atomic
+            if self._stop.is_set():
+                raise RuntimeError("scheduler is shut down")
+            self._queue.put(item)
         if not item.done.wait(timeout):
             raise TimeoutError("generation timed out")
         if item.error is not None:
@@ -427,8 +447,21 @@ class ContinuousScheduler:
 
     def shutdown(self):
         self._stop.set()
-        self._queue.put(None)
+        with self._lifecycle_lock:
+            self._queue.put(None)
         self._worker.join(timeout=5)
+        # the worker's own drain ran before join returned; under the lock no
+        # new item can have been enqueued since — sweep anything that raced
+        # in between the worker's drain and _stop becoming visible
+        with self._lifecycle_lock:
+            while True:
+                try:
+                    it = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if it is not None:
+                    it.error = RuntimeError("scheduler is shut down")
+                    it.done.set()
 
     # ------------------------------------------------------------------
     def _run(self):
@@ -487,6 +520,13 @@ class ContinuousScheduler:
                 except BaseException as e:  # noqa: BLE001 — deliver to waiter
                     item.error = e
                     item.done.set()
+                    if isinstance(e, EngineStateLost):
+                        # the reset wiped every in-flight slot: their
+                        # requests can never complete — fail them now
+                        for w in waiting.values():
+                            w.error = e
+                            w.done.set()
+                        waiting.clear()
                 try:
                     item = self._queue.get_nowait()
                 except queue.Empty:

@@ -44,14 +44,18 @@ def make_train_step(
     config: LlamaConfig,
     dtypes: DTypePolicy = DTypePolicy(),
     optimizer: Optional[optax.GradientTransformation] = None,
+    mesh=None,
 ):
     """Returns ``(init_opt_state, train_step)``; ``train_step`` is jittable and
     sharding-transparent: with TP/DP-placed params and dp-sharded batches, XLA
     emits the ICI collectives (grad psum over dp, activation collectives over
-    tp) — no pmap, no hand-written comms."""
+    tp) — no pmap, no hand-written comms. Pass the ``jax.sharding.Mesh`` to
+    enable sequence parallelism: with ``sp > 1`` in the mesh, attention runs
+    as the differentiable ring over the sp axis (sequences shard across
+    devices; K/V blocks rotate on the ICI ring)."""
     # "xla" attention: the dense-einsum path is the differentiable one (the
     # Pallas kernels are inference-only, no custom VJP)
-    model = LlamaModel(config, dtypes, attn_impl="xla")
+    model = LlamaModel(config, dtypes, attn_impl="xla", mesh=mesh)
     opt = optimizer or optax.adamw(1e-5)
 
     def init_opt_state(params):

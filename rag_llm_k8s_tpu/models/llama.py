@@ -213,6 +213,18 @@ class Attention(nn.Module):
             if mesh is not None and "tp" in mesh.axis_names
             else 1
         )
+        sp = (
+            mesh.shape["sp"]
+            if mesh is not None and "sp" in mesh.axis_names
+            else 1
+        )
+        if mode == "prefill" and sp > 1 and q.shape[1] % sp == 0:
+            # sequence parallelism: prefill/training attention runs as RING
+            # attention over the sp axis — each device holds S/sp of the
+            # sequence, K/V blocks rotate via ppermute on the ICI ring
+            # (parallel/ring_attention.py). Differentiable (the training
+            # path), composes with tp over heads.
+            return self._attend_ring(q, k, v, kv_start, kv_len, sp, tp)
         heads_shardable = tp > 1 and H % tp == 0 and K % tp == 0
         if impl != "xla" and tp > 1 and not heads_shardable:
             # head counts don't tile the tp axis: an unsharded Pallas call
@@ -275,6 +287,34 @@ class Attention(nn.Module):
                 jnp.asarray(write_index, jnp.int32).reshape(1),
             )
         return kernel(q, k, v, kv_start, kv_len)
+
+    def _attend_ring(self, q, k, v, kv_start, kv_len, sp: int, tp: int) -> jax.Array:
+        """Sequence-parallel prefill attention: shard_map over ``sp`` (and
+        ``tp`` when head counts divide it), ring K/V rotation inside."""
+        from jax.experimental.shard_map import shard_map
+
+        from rag_llm_k8s_tpu.parallel.ring_attention import ring_attention
+
+        mesh = self.mesh
+        B, S, H, hd = q.shape
+        K = k.shape[2]
+        tp_axis = "tp" if (tp > 1 and H % tp == 0 and K % tp == 0) else None
+        dp = mesh.shape["dp"] if "dp" in mesh.axis_names else 1
+        dp_axis = "dp" if (dp > 1 and B % dp == 0) else None
+        t = jnp.arange(S)
+        valid = (t[None, :] >= kv_start[:, None]) & (t[None, :] < kv_len[:, None])
+
+        hspec = P(dp_axis, "sp", tp_axis, None)
+        fn = shard_map(
+            lambda q_, k_, v_, val_: ring_attention(
+                q_, k_, v_, axis_name="sp", causal=True, kv_valid=val_
+            ),
+            mesh=mesh,
+            in_specs=(hspec, hspec, hspec, P(dp_axis, "sp")),
+            out_specs=hspec,
+            check_rep=False,
+        )
+        return fn(q, k, v, valid).astype(q.dtype)
 
     @nn.compact
     def __call__(

@@ -49,6 +49,10 @@ class TestStreaming8B:
         assert ckpt_bytes > 2 * GB  # true-shape sanity: L=2 slice is ~3 GB
 
         proc = psutil.Process()
+        # ru_maxrss is a process-LIFETIME high-water mark: snapshot it before
+        # the load so the assertion measures this load's transient, not
+        # whatever earlier tests in the same process peaked at
+        peak_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
         put = make_streaming_put(mesh_tp8, dtype=jnp.bfloat16)
         params = load_safetensors_params(
             synth_dir, CFG_8B_L2, DTypePolicy(), put=put
@@ -89,7 +93,7 @@ class TestStreaming8B:
         # a couple of vocab-sized tensors (embed read + lm_head transpose),
         # never the multi-GB whole-checkpoint spike from_pretrained makes.
         embed_bytes = c.vocab_size * c.hidden_size * 2
-        transient = peak - rss_after
+        transient = peak - max(rss_after, peak_before)
         assert transient < 3 * embed_bytes + 512 * (1 << 20), (
             f"transient host overhead {transient / GB:.2f} GB suggests the "
             f"loader materialized more than a streamed group"
