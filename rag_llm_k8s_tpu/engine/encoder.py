@@ -51,6 +51,19 @@ class EncoderRunner:
             )
         )
 
+    def prepare_batch(self, ids: Sequence[int]):
+        """One bucketed, padded, EOS-preserving ``[1, S]`` (tokens, mask)
+        pair — the SAME truncation/bucketing rules the ingest path applies,
+        shared with the server's fused query-retrieval so query and chunk
+        embeddings can never diverge."""
+        S = bucket_len(max(len(ids), 1), self.length_buckets)
+        ids = truncate_keep_eos(ids, S, self.eos_id)
+        tokens = np.full((1, S), self.config.pad_token_id, np.int32)
+        mask = np.zeros((1, S), np.int32)
+        tokens[0, : len(ids)] = ids
+        mask[0, : len(ids)] = 1
+        return tokens, mask
+
     def encode(self, token_lists: Sequence[Sequence[int]]) -> np.ndarray:
         """Token-id sequences → ``[N, hidden]`` fp32 unit vectors."""
         if not token_lists:

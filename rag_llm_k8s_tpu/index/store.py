@@ -169,7 +169,12 @@ class VectorStore:
     # ------------------------------------------------------------------
     # search (on device)
     # ------------------------------------------------------------------
-    def _device_snapshot(self) -> Tuple[jax.Array, jax.Array]:
+    def device_snapshot(self) -> Tuple[jax.Array, jax.Array]:
+        """The immutable device pair ``(emb [cap, D] fp32, sq_norms [1, cap])``
+        consumers rank against (e.g. the server's fused embed+kNN call).
+        Contract: rows past ``ntotal`` are zero vectors whose norms are BIG,
+        so they can never enter a top-k with ``k <= ntotal``; the pair is
+        never mutated — mutation swaps in a new pair under the lock."""
         with self._lock:
             if self._dev is not None:
                 return self._dev
@@ -190,10 +195,15 @@ class VectorStore:
         if n == 0:
             return []
         k_eff = min(k, n)
-        emb, norms = self._device_snapshot()
+        emb, norms = self.device_snapshot()
         q = np.asarray(query, np.float32).reshape(1, self.dim)
         dists, idx = knn_topk(jnp.asarray(q), emb, norms, k=k_eff)
-        dists, idx = np.asarray(dists[0]), np.asarray(idx[0])
+        return self.results_at(np.asarray(idx[0]), np.asarray(dists[0]))
+
+    def results_at(self, idx, dists) -> List[SearchResult]:
+        """Materialize SearchResults for externally computed (idx, dists) —
+        the fused embed+kNN serving path ranks on device and only the final
+        k indices ever reach the host."""
         return [
             SearchResult(metadata=self._metadata[int(i)], distance=float(d))
             for d, i in zip(dists, idx)
