@@ -10,9 +10,14 @@
 test:
 	python -m pytest tests/ -q
 
-# one retry: the tunneled TPU platform (axon, experimental) occasionally
-# returns transient garbage for a single transfer; a persistent failure
-# still fails the gate (both runs must break)
+# One retry of only the failed tests: the tunneled TPU platform (axon,
+# experimental) occasionally corrupts a computation's output under long
+# sessions (observed: stock-XLA oracle returning all-NaN over finite
+# inputs, identical rerun clean). TRADE-OFF, accepted deliberately: the
+# retry can also mask a genuinely flaky kernel regression; the kernels'
+# deterministic interpret-mode parity tests in tests/ (no retry) remain
+# the correctness gate for kernel logic, and a persistent hardware failure
+# still fails here (both runs must break).
 tpu-test:
 	python -m pytest tests_tpu/ -q || python -m pytest tests_tpu/ -q --last-failed
 

@@ -193,7 +193,7 @@ def measure_query_e2e() -> dict:
     ingest_s = time.monotonic() - t0
 
     client.post("/query", json={"prompt": QUERIES[0]})  # warm the query path end to end
-    lat_ms, stages = [], {"embed_ms": [], "retrieve_ms": [], "generate_ms": []}
+    lat_ms, stages = [], {"tokenize_ms": [], "embed_retrieve_ms": [], "generate_ms": []}
     for q in QUERIES:
         t0 = time.monotonic()
         r = client.post("/query", json={"prompt": q})

@@ -88,6 +88,10 @@ class RagService:
         self.ready = False
         # compiled fused embed+kNN executables, keyed (bucket, index_pad, k)
         self._fused_retrieve: Dict[tuple, object] = {}
+        # ONE EOS policy for ingest and query truncation alike: default the
+        # runner's eos from the tokenizer so the two paths cannot diverge
+        if encoder is not None and getattr(encoder, "eos_id", None) is None:
+            encoder.eos_id = getattr(encoder_tokenizer, "eos_id", None)
 
     # -- embedding ------------------------------------------------------
     def embed_texts(self, texts: List[str]) -> np.ndarray:
@@ -117,10 +121,14 @@ class RagService:
         if added and self.store.path:
             self.store.save()
         if added and self.ready:
-            # pre-warm the fused retrieval executable for the (possibly
-            # grown) snapshot bucket so the next query doesn't pay compile
+            # pre-warm the fused retrieval executable, but ONLY when the
+            # index snapshot outgrew its padded bucket (a new executable is
+            # needed O(log N) times ever — bulk ingest must not pay a device
+            # call per document)
             try:
-                self._retrieve("warmup")
+                cap = self.store.device_snapshot()[0].shape[0]
+                if not any(k[1] == cap for k in self._fused_retrieve):
+                    self._retrieve("warmup")
             except Exception:  # noqa: BLE001 — warmup must not fail ingest
                 logger.exception("post-ingest retrieval warmup failed")
         self.metrics.observe("ingest_seconds", time.monotonic() - t0)
@@ -160,19 +168,13 @@ class RagService:
         n = self.store.ntotal
         if n == 0:
             return [], 0.0
-        t0 = time.monotonic()
         k_eff = min(self.config.retrieval.k, n)
         emb, norms = self.store.device_snapshot()
-        eos = self.encoder.eos_id
-        if eos is None:
-            eos = getattr(self.encoder_tokenizer, "eos_id", None)
-        ids = truncate_keep_eos(
-            self.encoder_tokenizer.encode(text),
-            self.config.encoder.max_encode_len, eos,
-        )
-        # the runner's own bucketing/truncation rules — query and chunk
-        # embeddings go through identical preparation
-        tokens, mask = self.encoder.prepare_batch(ids)
+        t0 = time.monotonic()
+        # the runner's own bucketing/truncation/EOS rules (its buckets are
+        # already clamped to max_encode_len) — query and chunk embeddings go
+        # through identical preparation
+        tokens, mask = self.encoder.prepare_batch(self.encoder_tokenizer.encode(text))
         tokenize_ms = (time.monotonic() - t0) * 1e3
 
         key = (tokens.shape[1], emb.shape[0], k_eff)
@@ -195,12 +197,14 @@ class RagService:
         timings: Dict[str, float] = {}
         t_all = time.monotonic()
 
-        # embed + kNN are one fused device call; embed_ms keeps its slot in
-        # the timings contract, reporting the host-side tokenize/prepare cost
+        # embed + kNN run as ONE fused device call, so they cannot be timed
+        # separately; the keys say so explicitly instead of repurposing the
+        # old embed_ms/retrieve_ms split (which would silently skew any
+        # cross-version comparison of stage timings)
         t0 = time.monotonic()
         results, tokenize_ms = self._retrieve(user_prompt)
-        timings["embed_ms"] = tokenize_ms
-        timings["retrieve_ms"] = (time.monotonic() - t0) * 1e3 - tokenize_ms
+        timings["tokenize_ms"] = tokenize_ms
+        timings["embed_retrieve_ms"] = (time.monotonic() - t0) * 1e3 - tokenize_ms
 
         if not results:
             return {"generated_text": "No relevant information found in the index."}

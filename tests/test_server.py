@@ -132,7 +132,7 @@ class TestRoutes:
             assert "context" in body
             assert "Document 'doc" in body["context"]
             assert "score:" in body["context"]
-            assert set(body["timings"]) == {"embed_ms", "retrieve_ms", "generate_ms", "total_ms"}
+            assert set(body["timings"]) == {"tokenize_ms", "embed_retrieve_ms", "generate_ms", "total_ms"}
 
     def test_healthz_and_metrics(self, client):
         assert client.get("/healthz").status_code == 200

@@ -126,7 +126,7 @@ class TestEngineOnChip:
         pos = jnp.clip(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
         real_len = jnp.sum(pad_mask, axis=-1)
 
-        def run(impl):
+        def run_once(impl):
             with jax.default_matmul_precision("highest"):
                 model = LlamaModel(cfg, fp32, attn_impl=impl)
                 cache = make_kv_cache(cfg, B, T, jnp.float32)
@@ -143,8 +143,24 @@ class TestEngineOnChip:
                 )(params, tokens[:, -1:], cache)
             return np.asarray(plog), np.asarray(dlog)
 
-        p_ref, d_ref = run("xla")
-        p_got, d_got = run("pallas")
+        p_ref, d_ref = run_once("xla")
+        if not (np.isfinite(p_ref).all() and np.isfinite(d_ref).all()):
+            # Known artifact of the tunneled (axon, experimental) platform:
+            # under a long session the ORACLE forward — stock XLA einsum/
+            # softmax with no scratch memory, where a race is impossible —
+            # occasionally returns all-NaN over finite inputs, and rerunning
+            # the identical computation succeeds. Retry the ORACLE only; the
+            # Pallas side (the kernel under test, where uninitialized-scratch
+            # races WOULD look like nondeterministic NaN) is never retried,
+            # so a racy kernel bug still fails this test.
+            import warnings
+
+            warnings.warn(
+                "xla oracle returned non-finite values on the axon platform; "
+                "retrying the identical computation once"
+            )
+            p_ref, d_ref = run_once("xla")
+        p_got, d_got = run_once("pallas")
         valid = np.asarray(pad_mask).astype(bool)[:, :, None]
         np.testing.assert_allclose(
             np.where(valid, p_got, 0), np.where(valid, p_ref, 0), rtol=1e-4, atol=1e-4
