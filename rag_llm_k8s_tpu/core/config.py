@@ -259,6 +259,10 @@ class EngineConfig:
     # attention backend: "auto" = fused Pallas kernels on TPU, XLA einsum
     # oracle elsewhere (see models.llama.Attention)
     attn_impl: str = "auto"
+    # fuse q/k/v and gate/up projections into single matmuls at engine
+    # construction (same HBM bytes, ~40% fewer kernels per decode step);
+    # applies only when tp == 1 — a plain concat cannot be tp-sharded
+    fuse_matmuls: bool = True
 
 
 @dataclass(frozen=True)

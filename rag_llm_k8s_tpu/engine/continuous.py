@@ -83,7 +83,6 @@ class ContinuousEngine:
         pad_id: int = 0,
     ):
         self.config = config
-        self.params = params
         self.sampling = sampling
         self.engine_config = engine_config
         self.dtypes = dtypes
@@ -102,8 +101,12 @@ class ContinuousEngine:
                 f"max_seq_len={engine_config.max_seq_len} (slot length {self.T})"
             )
         jmesh = mesh.mesh if mesh is not None and mesh.tp > 1 else None
+        from rag_llm_k8s_tpu.engine.engine import maybe_fuse_params
+
+        self.params, fused = maybe_fuse_params(params, engine_config, mesh)
         self.model = LlamaModel(
-            config, dtypes, attn_impl=engine_config.attn_impl, mesh=jmesh
+            config, dtypes, attn_impl=engine_config.attn_impl, mesh=jmesh,
+            fused_qkv=fused,
         )
         self.model_step = self.model.copy(row_frontier=True)
         self._compiled: Dict[Tuple[str, int], jax.stages.Compiled] = {}

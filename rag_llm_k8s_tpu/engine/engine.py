@@ -60,6 +60,20 @@ def _isin(tokens: jax.Array, ids: Tuple[int, ...]) -> jax.Array:
     return hit
 
 
+def maybe_fuse_params(params, engine_config: EngineConfig, mesh):
+    """Fuse q/k/v and gate/up projection weights once at engine construction
+    when the config allows it and tp == 1 (the fused concat layout cannot be
+    tp-sharded — see ``models.llama.fuse_llama_params``). Returns
+    ``(params, fused?)``; already-fused or sharded trees pass through."""
+    from rag_llm_k8s_tpu.models.llama import fuse_llama_params
+
+    tp = mesh.tp if mesh is not None else 1
+    attn = params.get("layers", {}).get("attn", {}) if isinstance(params, dict) else {}
+    if not engine_config.fuse_matmuls or tp > 1 or "wq" not in attn:
+        return params, "wqkv" in attn
+    return fuse_llama_params(params), True
+
+
 @dataclass
 class EngineStats:
     prefill_tokens: int = 0
@@ -81,17 +95,18 @@ class InferenceEngine:
         pad_id: int = 0,
     ):
         self.config = config
-        self.params = params
         self.sampling = sampling
         self.engine_config = engine_config
         self.dtypes = dtypes
         self.mesh = mesh
         self.pad_id = pad_id
+        self.params, fused = maybe_fuse_params(params, engine_config, mesh)
         self.model = LlamaModel(
             config,
             dtypes,
             attn_impl=engine_config.attn_impl,
             mesh=(mesh.mesh if mesh is not None and mesh.tp > 1 else None),
+            fused_qkv=fused,
         )
         # same params, STATIC chunked=True: prompts longer than the largest
         # bucket prefill through the cache chunk by chunk (offset-causal

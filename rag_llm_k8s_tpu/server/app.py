@@ -127,7 +127,10 @@ class RagService:
             # call per document)
             try:
                 cap = self.store.device_snapshot()[0].shape[0]
-                if not any(k[1] == cap for k in self._fused_retrieve):
+                k_eff = min(self.config.retrieval.k, self.store.ntotal)
+                if not any(
+                    k[1] == cap and k[2] == k_eff for k in self._fused_retrieve
+                ):
                     self._retrieve("warmup")
             except Exception:  # noqa: BLE001 — warmup must not fail ingest
                 logger.exception("post-ingest retrieval warmup failed")

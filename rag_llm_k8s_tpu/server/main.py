@@ -118,8 +118,11 @@ def build_service():
             ContinuousScheduler,
         )
 
+        # engine.params is already fused when tp == 1; passing it (rather
+        # than the raw tree) lets the two engines SHARE the fused weight
+        # buffers instead of materializing a second concatenated copy in HBM
         cont = ContinuousEngine(
-            model_cfg, params, sampling=config.sampling,
+            model_cfg, engine.params, sampling=config.sampling,
             engine_config=config.engine, dtypes=config.dtypes, mesh=mesh,
         )
         scheduler = ContinuousScheduler(cont)

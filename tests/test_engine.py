@@ -261,3 +261,37 @@ class TestChunkedPrefill:
         out = eng.generate([prompt], max_new_tokens=10_000)[0]
         assert len(out) <= 48 - 32  # budget = max_seq_len - largest bucket
         assert all(k[2] <= 16 for k in eng._compiled)  # no runaway executable
+
+
+class TestFusedProjections:
+    def test_fusion_applied_and_greedy_identical(self, tiny_engine):
+        """With tp=1 the engine fuses q/k/v and gate/up into single matmuls;
+        tokens must be bit-identical to an engine with fusion disabled."""
+        cfg, params, eng_fused = tiny_engine  # module engine: fusion on
+        attn = eng_fused.params["layers"]["attn"]
+        assert "wqkv" in attn and "wq" not in attn  # actually fused
+
+        eng_plain = InferenceEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=EngineConfig(prompt_buckets=(16, 32), max_batch_size=4,
+                                       fuse_matmuls=False),
+            dtypes=FP32,
+        )
+        assert "wq" in eng_plain.params["layers"]["attn"]
+        prompts = [[3, 17, 42, 7, 99], [5, 5, 8], [11] * 12]
+        assert eng_fused.generate(prompts) == eng_plain.generate(prompts)
+
+    def test_tp_mesh_keeps_unfused_layout(self, mesh_tp8):
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            LlamaConfig.tiny(), num_heads=8, num_kv_heads=8, head_dim=8, hidden_size=64
+        )
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, FP32)
+        from rag_llm_k8s_tpu.parallel.sharding import shard_llama_params
+
+        eng = InferenceEngine(
+            cfg, shard_llama_params(params, mesh_tp8), sampling=GREEDY,
+            engine_config=SMALL_ENGINE, dtypes=FP32, mesh=mesh_tp8,
+        )
+        assert "wq" in eng.params["layers"]["attn"]  # fused layout can't shard
