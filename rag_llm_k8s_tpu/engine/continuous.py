@@ -45,7 +45,12 @@ from rag_llm_k8s_tpu.core.config import (
     SamplingConfig,
 )
 from rag_llm_k8s_tpu.core.mesh import MeshContext
-from rag_llm_k8s_tpu.engine.engine import EngineStats, _isin
+from rag_llm_k8s_tpu.engine.engine import (
+    EngineStats,
+    _isin,
+    maybe_fuse_params,
+    param_avals,
+)
 from rag_llm_k8s_tpu.engine.sampling import sample_token, sample_token_per_row
 from rag_llm_k8s_tpu.models.llama import LlamaModel, make_kv_cache, mask_window
 from rag_llm_k8s_tpu.utils.buckets import bucket_len
@@ -101,8 +106,6 @@ class ContinuousEngine:
                 f"max_seq_len={engine_config.max_seq_len} (slot length {self.T})"
             )
         jmesh = mesh.mesh if mesh is not None and mesh.tp > 1 else None
-        from rag_llm_k8s_tpu.engine.engine import maybe_fuse_params
-
         self.params, fused = maybe_fuse_params(params, engine_config, mesh)
         self.model = LlamaModel(
             config, dtypes, attn_impl=engine_config.attn_impl, mesh=jmesh,
@@ -184,7 +187,7 @@ class ContinuousEngine:
             return cache.k, cache.v, tok0, kv_start[0]
 
         return jax.jit(prefill).lower(
-            self._param_avals(),
+            param_avals(self.params),
             jax.ShapeDtypeStruct((1, S), jnp.int32),
             jax.ShapeDtypeStruct((1, S), jnp.int32),
             jax.ShapeDtypeStruct((2,), jnp.uint32),
@@ -260,7 +263,7 @@ class ContinuousEngine:
         # kv_start (3) and rng_keys (7) are NOT donated: neither is among the
         # outputs, and the host keeps using their buffers across steps
         return jax.jit(step, donate_argnums=(1, 2, 4, 5, 6)).lower(
-            self._param_avals(),
+            param_avals(self.params),
             jax.ShapeDtypeStruct((L, B, K, T, hd), cdt),
             jax.ShapeDtypeStruct((L, B, K, T, hd), cdt),
             jax.ShapeDtypeStruct((B,), i32),
@@ -270,13 +273,6 @@ class ContinuousEngine:
             jax.ShapeDtypeStruct((B, 2), jnp.uint32),
         ).compile()
 
-    def _param_avals(self):
-        return jax.tree.map(
-            lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=leaf.sharding)
-            if isinstance(leaf, jax.Array)
-            else jax.ShapeDtypeStruct(np.shape(leaf), np.asarray(leaf).dtype),
-            self.params,
-        )
 
     # ------------------------------------------------------------------
     # operations (called by the scheduler thread only)

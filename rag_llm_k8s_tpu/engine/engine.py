@@ -60,6 +60,17 @@ def _isin(tokens: jax.Array, ids: Tuple[int, ...]) -> jax.Array:
     return hit
 
 
+def param_avals(params):
+    """Abstract (shape, dtype, sharding) tree for AOT ``.lower()`` calls —
+    shared by the one-shot and continuous engines."""
+    return jax.tree.map(
+        lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=leaf.sharding)
+        if isinstance(leaf, jax.Array)
+        else jax.ShapeDtypeStruct(np.shape(leaf), np.asarray(leaf).dtype),
+        params,
+    )
+
+
 def maybe_fuse_params(params, engine_config: EngineConfig, mesh):
     """Fuse q/k/v and gate/up projection weights once at engine construction
     when the config allows it and tp == 1 (the fused concat layout cannot be
@@ -69,6 +80,11 @@ def maybe_fuse_params(params, engine_config: EngineConfig, mesh):
 
     tp = mesh.tp if mesh is not None else 1
     attn = params.get("layers", {}).get("attn", {}) if isinstance(params, dict) else {}
+    if "wqkv" in attn and tp > 1:
+        raise ValueError(
+            "params are in the fused wqkv layout, which cannot be tp-sharded "
+            "— pass the canonical (unfused) tree when tp > 1"
+        )
     if not engine_config.fuse_matmuls or tp > 1 or "wq" not in attn:
         return params, "wqkv" in attn
     return fuse_llama_params(params), True
@@ -216,18 +232,13 @@ class InferenceEngine:
             return out
 
         # AOT-compile from abstract shapes (no execution)
-        param_avals = jax.tree.map(
-            lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=leaf.sharding)
-            if isinstance(leaf, jax.Array)
-            else jax.ShapeDtypeStruct(np.shape(leaf), np.asarray(leaf).dtype),
-            self.params,
-        )
+        avals = param_avals(self.params)
         data_sharding = self.mesh.replicated if self.mesh is not None else None
         tok_aval = jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=data_sharding)
         rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=data_sharding)
         return (
             jax.jit(gen)
-            .lower(param_avals, tok_aval, tok_aval, rng_aval)
+            .lower(avals, tok_aval, tok_aval, rng_aval)
             .compile()
         )
 

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from rag_llm_k8s_tpu.ops.knn import BIG, knn_topk
+from rag_llm_k8s_tpu.utils.buckets import next_pow2
 
 _FORMAT_VERSION = 1
 
@@ -79,8 +80,6 @@ def _content_hash(metadata: Dict) -> str:
 
 
 def _pad_bucket(n: int, minimum: int = 512) -> int:
-    from rag_llm_k8s_tpu.utils.buckets import next_pow2
-
     return max(minimum, next_pow2(n))
 
 
@@ -151,8 +150,6 @@ class VectorStore:
             return  # nothing materialized yet; first search uploads once
         emb, norms = self._dev
         n_real = new_rows.shape[0]
-        from rag_llm_k8s_tpu.utils.buckets import next_pow2
-
         n_pad = next_pow2(max(n_real, 1))
         if n_old + n_pad > emb.shape[0]:
             self._dev = None  # bucket growth: full re-upload on next search

@@ -564,13 +564,17 @@ def mask_window(pad_mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return start, start + jnp.sum(m, axis=-1).astype(jnp.int32)
 
 
-@jax.jit
 def fuse_llama_params(params: dict) -> dict:
     """Fuse the per-layer projection weights for ``LlamaModel(fused_qkv=True)``:
     ``wq|wk|wv -> wqkv`` and ``w_gate|w_up -> w_gateup`` (one concat along the
     output dim, done ONCE on device at engine construction). Valid only
     unsharded / tp=1 — a tp split would cross the concat boundaries. The
-    canonical (checkpoint / training / sharding) layout stays unfused."""
+    canonical (checkpoint / training / sharding) layout stays unfused.
+    Deliberately NOT jitted: a jitted version would copy every pass-through
+    leaf (embedding, lm_head, norms, wo, w_down) into fresh buffers —
+    doubling peak weight memory at construction — whereas this rebuild
+    reuses the original leaf references and allocates only the four
+    concatenated kernels."""
     attn = params["layers"]["attn"]
     mlp = params["layers"]["mlp"]
     fused = dict(params)

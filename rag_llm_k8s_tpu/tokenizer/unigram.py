@@ -20,7 +20,7 @@ from rag_llm_k8s_tpu.tokenizer.normalize import (
 )
 from rag_llm_k8s_tpu.utils.tokens import compile_special_re
 
-_SPACE = "▁"  # ▁
+_SPACE = "\u2581"  # the SentencePiece metaspace marker
 
 
 class _Trie:
