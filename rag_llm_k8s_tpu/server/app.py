@@ -215,9 +215,13 @@ class RagService:
         context, prompt_ids = self._budgeted_prompt(user_prompt, results)
 
         t0 = time.monotonic()
-        if self.scheduler is not None:
+        if self.scheduler is not None and len(prompt_ids) <= self._scheduler_prompt_cap():
             out_ids = self.scheduler.submit(prompt_ids)
         else:
+            # prompts beyond the scheduler's capability need chunked
+            # prefill, which fixed-length continuous slots cannot do — the
+            # one-shot engine runs them through the cache chunk by chunk
+            # instead of letting the scheduler truncate them
             out_ids = self.engine.generate([prompt_ids])[0]
         completion = self.llm_tokenizer.decode(out_ids)
         timings["generate_ms"] = (time.monotonic() - t0) * 1e3
@@ -230,6 +234,16 @@ class RagService:
             "context": context,
             "timings": {k: round(v, 2) for k, v in timings.items()},
         }
+
+    def _scheduler_prompt_cap(self) -> int:
+        """Longest prompt the serving scheduler can take WITHOUT truncating.
+        Continuous slots expose their admissible bucket ladder (``buckets``);
+        the coalescing scheduler delegates to the chunk-capable one-shot
+        engine, so it has no cap of its own."""
+        slot_buckets = getattr(self.scheduler.engine, "buckets", None)
+        if slot_buckets is None:
+            return 1 << 62  # coalescing path: engine.generate chunks as needed
+        return max(slot_buckets)
 
     def _budgeted_prompt(self, user_prompt: str, results) -> tuple:
         """Assemble context + prompt ids, shrinking the context until the
@@ -267,9 +281,17 @@ class RagService:
                 # proportional jump toward the budget (0.9 safety margin), so
                 # trimming converges in a couple of re-encodes, not O(n) passes
                 target = min(len(words) - 1, int(len(words) * budget / len(ids) * 0.9))
-                if target < 10:  # irreducible: serve what fits via truncation
-                    logger.warning("prompt irreducibly over %d-token budget; hard truncating", budget)
-                    return context, ids[:1] + ids[1 + (len(ids) - budget):]
+                if target < 10:
+                    # irreducible: the QUESTION alone exceeds the bucket. The
+                    # engine can chunk-prefill up to max_chunked_prompt, so
+                    # hand the full prompt through (answer() routes over-
+                    # bucket prompts to the chunk-capable engine) and only
+                    # the engine's own loud cap ever truncates.
+                    logger.warning(
+                        "prompt irreducibly over the %d-token bucket; serving "
+                        "via chunked prefill (%d tokens)", budget, len(ids),
+                    )
+                    return context, ids
                 used[0].metadata["text"] = " ".join(words[:target])
                 trimmed_to = target
 
@@ -287,6 +309,15 @@ class RagService:
         serving_engine.warmup(
             batch_sizes=(1,), buckets=serving_engine.engine_config.prompt_buckets
         )
+        if serving_engine is not self.engine:
+            # over-bucket prompts bypass the scheduler into the one-shot
+            # engine's chunked prefill — warm one representative overflow
+            # shape so the first long request doesn't pay the compile
+            ec = self.engine.engine_config
+            largest = max(ec.prompt_buckets)
+            mn = max(1, min(self.engine.sampling.max_new_tokens,
+                            ec.max_seq_len - largest))
+            self.engine._get_compiled(1, 2 * largest, mn, largest)
         self.embed_texts(["warmup"])
         # compile the fused embed+kNN executable and upload the index
         # snapshot (no-op while the index is empty; ingest re-warms)
@@ -370,11 +401,21 @@ class WsgiApp:
 
     def ep_metrics(self, request):
         snap = self.service.metrics.snapshot()
-        # counters must come from the engine that serves traffic — the
-        # scheduler's (continuous or coalescing), not the idle one-shot one
+        # BOTH serving engines count: the scheduler's handles in-bucket
+        # traffic, while over-bucket prompts run through the one-shot
+        # engine's chunked prefill — summing keeps long-prompt requests
+        # visible instead of vanishing from the counters
         svc = self.service
-        serving = svc.scheduler.engine if svc.scheduler is not None else svc.engine
-        stats = serving.stats
+        engines = {id(svc.engine): svc.engine}
+        if svc.scheduler is not None:
+            engines[id(svc.scheduler.engine)] = svc.scheduler.engine
+        from rag_llm_k8s_tpu.engine.engine import EngineStats
+
+        stats = EngineStats(
+            prefill_tokens=sum(e.stats.prefill_tokens for e in engines.values()),
+            decode_tokens=sum(e.stats.decode_tokens for e in engines.values()),
+            generate_calls=sum(e.stats.generate_calls for e in engines.values()),
+        )
         snap.update(
             {
                 "engine_generate_calls": stats.generate_calls,

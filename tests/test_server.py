@@ -211,3 +211,57 @@ class TestEmbedTruncation:
         assert len(long_ids) == limit
         assert long_ids[-1] == 2  # EOS survives truncation
         assert short_ids[-1] == 2 and short_ids[0] == 1  # untouched
+
+
+class TestLongPromptRouting:
+    def test_over_bucket_prompt_bypasses_scheduler_for_chunked_prefill(self):
+        """A /generate prompt beyond the largest bucket must run through the
+        chunk-capable one-shot engine, not the fixed-slot scheduler (which
+        would loudly truncate it)."""
+        llama_cfg = LlamaConfig.tiny(vocab_size=300)
+        enc_cfg = EncoderConfig.tiny(vocab_size=300)
+        cfg = AppConfig(model=llama_cfg, encoder=enc_cfg)
+        engine = InferenceEngine(
+            llama_cfg,
+            init_llama_params(jax.random.PRNGKey(0), llama_cfg, FP32),
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=4),
+            engine_config=EngineConfig(prompt_buckets=(128, 512), max_batch_size=2),
+            dtypes=FP32,
+        )
+
+        class SlotEngineStub:
+            # models a ContinuousEngine: fixed slot ladder, no chunking
+            buckets = (128, 512)
+            engine_config = engine.engine_config
+            stats = engine.stats
+
+        class RecordingScheduler:
+            def __init__(self):
+                self.engine = SlotEngineStub()
+                self.submitted = []
+
+            def submit(self, prompt, **kw):
+                self.submitted.append(len(prompt))
+                return engine.generate([prompt])[0]
+
+        encoder = EncoderRunner(
+            enc_cfg,
+            init_encoder_params(jax.random.PRNGKey(1), enc_cfg, FP32),
+            dtypes=FP32, length_buckets=(32,), max_batch=4,
+        )
+        store = VectorStore(dim=enc_cfg.hidden_size)
+        svc = RagService(cfg, engine, ByteTokenizer(), encoder, ByteTokenizer(),
+                         store, scheduler=RecordingScheduler())
+        svc.ready = True
+        # seed the index so answer() reaches generation; tiny chunk text
+        # keeps the assembled prompt under the bucket for the short case
+        vec = encoder.encode([ByteTokenizer().encode("tiny")])[0]
+        store.add([vec], [{"filename": "f", "chunk_id": 0, "text": "ok"}])
+
+        svc.answer("hi")  # short: assembled prompt fits -> scheduler path
+        assert svc.scheduler.submitted, "short prompt should use the scheduler"
+
+        before = list(svc.scheduler.submitted)
+        svc.answer("x" * 1200)  # long: prompt exceeds bucket 512 -> engine path
+        assert svc.scheduler.submitted == before  # scheduler NOT used
+        assert any(k[3] == 512 for k in engine._compiled)  # chunked exe ran
