@@ -220,7 +220,13 @@ def measure_query_e2e() -> dict:
 
 
 def measure_tpu() -> dict:
-    """Decode throughput at the headline batch plus a batch sweep."""
+    """Decode throughput at the headline batch plus a batch sweep.
+
+    The headline number is bf16 — numerics-exact vs the CPU baseline's
+    engine. Weight-only int8 (``EngineConfig.weight_quant="int8"``, logit
+    parity bounds in tests/test_quant.py) is reported alongside at the
+    headline batch and at batch 1 (the single-request latency case).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -238,12 +244,16 @@ def measure_tpu() -> dict:
     shapes = jax.eval_shape(lambda: init_llama_params(jax.random.PRNGKey(0), config, dtypes))
     params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
-    def run(batch: int) -> float:
+    def run(batch: int, weight_quant: str = "bf16") -> float:
         engine = InferenceEngine(
             config,
             params,
             sampling=SamplingConfig(do_sample=False, max_new_tokens=NEW_TOKENS),
-            engine_config=EngineConfig(prompt_buckets=(PROMPT_LEN,), max_batch_size=batch),
+            engine_config=EngineConfig(
+                prompt_buckets=(PROMPT_LEN,),
+                max_batch_size=batch,
+                weight_quant=weight_quant,
+            ),
             dtypes=dtypes,
         )
         prompts = [[config.bos_token_id] * PROMPT_LEN] * batch
@@ -258,7 +268,8 @@ def measure_tpu() -> dict:
         return best
 
     sweep = {b: round(run(b), 1) for b in SWEEP_BATCHES}
-    return {"tok_per_s": sweep[BATCH], "sweep": sweep}
+    int8 = {b: round(run(b, "int8"), 1) for b in (1, BATCH)}
+    return {"tok_per_s": sweep[BATCH], "sweep": sweep, "int8": int8}
 
 
 def measure_cpu_baseline() -> float:
@@ -328,6 +339,7 @@ def main():
         "vs_baseline": round(tpu["tok_per_s"] / baseline, 1),
         "decode_batch": BATCH,
         "decode_batch_sweep": {str(b): v for b, v in tpu["sweep"].items()},
+        "decode_int8_tok_per_s": {str(b): v for b, v in tpu["int8"].items()},
         "query_p50_target_ms": 2000,  # BASELINE.md north star: p50 < 2 s
     }
     line.update(e2e)

@@ -263,6 +263,11 @@ class EngineConfig:
     # construction (same HBM bytes, ~40% fewer kernels per decode step);
     # applies only when tp == 1 — a plain concat cannot be tp-sharded
     fuse_matmuls: bool = True
+    # weight storage for serving: "bf16" (exact) or "int8" (weight-only
+    # per-channel quantization at engine construction — halves the HBM bytes
+    # every decode step streams, and fits 8B weights on one 16 GB chip;
+    # see models.llama.quantize_llama_params). Training always stays bf16.
+    weight_quant: str = "bf16"
 
 
 @dataclass(frozen=True)
@@ -352,6 +357,13 @@ class AppConfig:
                     f"TPU_RAG_BATCHING={mode!r}: expected 'continuous' or 'coalesce'"
                 )
             engine = dataclasses.replace(engine, batching=mode)
+        if "TPU_RAG_WEIGHT_QUANT" in env:
+            wq = env["TPU_RAG_WEIGHT_QUANT"]
+            if wq not in ("bf16", "int8"):
+                raise ValueError(
+                    f"TPU_RAG_WEIGHT_QUANT={wq!r}: expected 'bf16' or 'int8'"
+                )
+            engine = dataclasses.replace(engine, weight_quant=wq)
         return dataclasses.replace(
             cfg, server=server, mesh=mesh, sampling=sampling, engine=engine
         )

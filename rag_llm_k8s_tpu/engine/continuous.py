@@ -49,6 +49,7 @@ from rag_llm_k8s_tpu.engine.engine import (
     EngineStats,
     _isin,
     maybe_fuse_params,
+    maybe_quantize_params,
     param_avals,
 )
 from rag_llm_k8s_tpu.engine.sampling import sample_token, sample_token_per_row
@@ -107,9 +108,10 @@ class ContinuousEngine:
             )
         jmesh = mesh.mesh if mesh is not None and mesh.tp > 1 else None
         self.params, fused = maybe_fuse_params(params, engine_config, mesh)
+        self.params, quantized = maybe_quantize_params(self.params, engine_config)
         self.model = LlamaModel(
             config, dtypes, attn_impl=engine_config.attn_impl, mesh=jmesh,
-            fused_qkv=fused,
+            fused_qkv=fused, quantized=quantized,
         )
         self.model_step = self.model.copy(row_frontier=True)
         self._compiled: Dict[Tuple[str, int], jax.stages.Compiled] = {}

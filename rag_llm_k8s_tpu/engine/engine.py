@@ -85,9 +85,39 @@ def maybe_fuse_params(params, engine_config: EngineConfig, mesh):
             "params are in the fused wqkv layout, which cannot be tp-sharded "
             "— pass the canonical (unfused) tree when tp > 1"
         )
-    if not engine_config.fuse_matmuls or tp > 1 or "wq" not in attn:
+    # int8 trees from the streaming loader carry kernel_q, not kernel — they
+    # skip fusion (concat of already-quantized kernels is possible but the
+    # loader path targets 8B, where tp>1 or memory-tightness rules fusion out)
+    if (
+        not engine_config.fuse_matmuls
+        or tp > 1
+        or "wq" not in attn
+        or "kernel" not in attn["wq"]
+    ):
         return params, "wqkv" in attn
     return fuse_llama_params(params), True
+
+
+def maybe_quantize_params(params, engine_config: EngineConfig):
+    """Apply weight-only int8 quantization at engine construction when
+    ``EngineConfig.weight_quant == "int8"``. Already-quantized trees (any
+    ``kernel_q`` leaf — e.g. streamed in int8 by the loader) pass through.
+    Returns ``(params, quantized?)``. The caller-passed bf16 tree is NOT
+    donated — callers legitimately share one tree across engines — so both
+    trees coexist transiently; at 8B scale quantize during the streaming
+    load instead (``load_safetensors_params(..., quant="int8")``) and this
+    becomes the pass-through case."""
+    from rag_llm_k8s_tpu.models.llama import quantize_llama_params
+
+    attn = params.get("layers", {}).get("attn", {}) if isinstance(params, dict) else {}
+    already = any("kernel_q" in sub for sub in attn.values() if isinstance(sub, dict))
+    if engine_config.weight_quant not in ("bf16", "int8"):
+        raise ValueError(
+            f"weight_quant={engine_config.weight_quant!r}: expected 'bf16' or 'int8'"
+        )
+    if engine_config.weight_quant != "int8" or already:
+        return params, already
+    return quantize_llama_params(params), True
 
 
 @dataclass
@@ -117,12 +147,14 @@ class InferenceEngine:
         self.mesh = mesh
         self.pad_id = pad_id
         self.params, fused = maybe_fuse_params(params, engine_config, mesh)
+        self.params, quantized = maybe_quantize_params(self.params, engine_config)
         self.model = LlamaModel(
             config,
             dtypes,
             attn_impl=engine_config.attn_impl,
             mesh=(mesh.mesh if mesh is not None and mesh.tp > 1 else None),
             fused_qkv=fused,
+            quantized=quantized,
         )
         # same params, STATIC chunked=True: prompts longer than the largest
         # bucket prefill through the cache chunk by chunk (offset-causal

@@ -148,6 +148,47 @@ class RMSNorm(nn.Module):
         return (y * scale.astype(jnp.float32)).astype(self.dtypes.compute_dtype)
 
 
+class QuantDense(nn.Module):
+    """Weight-only int8 linear: ``y = (x @ int8_kernel) * scale``.
+
+    Decode is HBM-bandwidth-bound — every step re-reads every weight — so
+    storing kernels as int8 halves the bytes streamed per step vs bf16. The
+    int8 tensor is the ONLY copy in HBM: the ``astype`` rides the matmul's
+    operand load (XLA fuses the convert; int8 values up to ±127 are exact in
+    bf16) and the per-output-channel ``scale`` is a standard output epilogue
+    fusion, so no dequantized kernel is ever materialized. fp32 per-channel
+    scales bound the quantization error at ~0.4% RMS per channel.
+
+    Params: ``kernel_q`` int8 ``[in, features]``, ``qscale`` fp32
+    ``[features]`` (named to never collide with RMSNorm's ``scale``) —
+    produced by :func:`quantize_llama_params`, never trained (serving-only;
+    training stays bf16).
+    """
+
+    features: int
+    dtypes: DTypePolicy
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kq = self.param(
+            "kernel_q", nn.initializers.zeros, (x.shape[-1], self.features), jnp.int8
+        )
+        scale = self.param("qscale", nn.initializers.ones, (self.features,), jnp.float32)
+        dt = self.dtypes.compute_dtype
+        return jnp.dot(x, kq.astype(dt)) * scale.astype(dt)
+
+
+def _make_dense(module: nn.Module, dt: DTypePolicy, quantized: bool):
+    """The per-module linear factory: same call surface for the bf16 and the
+    weight-only-int8 paths, so Attention/MLP stay layout-agnostic."""
+    if quantized:
+        return lambda feats, name: QuantDense(feats, dt, parent=module, name=name)
+    return lambda feats, name: nn.Dense(
+        feats, use_bias=False, dtype=dt.compute_dtype, param_dtype=dt.param_dtype,
+        parent=module, name=name,
+    )
+
+
 class Attention(nn.Module):
     """GQA attention with two fused TPU paths and one differentiable oracle.
 
@@ -188,6 +229,9 @@ class Attention(nn.Module):
     # align with a tp split across the q/k/v boundary; the engine fuses
     # params at construction exactly when tp == 1 (see fuse_llama_params).
     fused_qkv: bool = False
+    # STATIC weight-only int8 switch: projections read QuantDense params
+    # ({kernel_q, scale} from quantize_llama_params) instead of bf16 kernels.
+    quantized: bool = False
 
     def _resolved_impl(self) -> str:
         if self.attn_impl not in ("auto", "pallas", "pallas_interpret", "xla"):
@@ -339,9 +383,7 @@ class Attention(nn.Module):
         c, dt = self.config, self.dtypes
         B, S, D = x.shape
         H, K, hd = c.num_heads, c.num_kv_heads, c.head_dim
-        dense = lambda feats, name: nn.Dense(  # noqa: E731
-            feats, use_bias=False, dtype=dt.compute_dtype, param_dtype=dt.param_dtype, name=name
-        )
+        dense = _make_dense(self, dt, self.quantized)
         if self.fused_qkv:
             qkv = dense((H + 2 * K) * hd, "wqkv")(x)
             q, k, v = jnp.split(qkv, [H * hd, (H + K) * hd], axis=-1)
@@ -415,13 +457,12 @@ class MLP(nn.Module):
     config: LlamaConfig
     dtypes: DTypePolicy
     fused: bool = False  # see Attention.fused_qkv
+    quantized: bool = False  # see Attention.quantized
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         c, dt = self.config, self.dtypes
-        dense = lambda feats, name: nn.Dense(  # noqa: E731
-            feats, use_bias=False, dtype=dt.compute_dtype, param_dtype=dt.param_dtype, name=name
-        )
+        dense = _make_dense(self, dt, self.quantized)
         if self.fused:
             gu = dense(2 * c.intermediate_size, "w_gateup")(x)
             gate, up = jnp.split(gu, 2, axis=-1)
@@ -444,19 +485,22 @@ class Block(nn.Module):
     chunked: bool = False
     row_frontier: bool = False
     fused_qkv: bool = False
+    quantized: bool = False
 
     @nn.compact
     def __call__(self, carry, kv_start, kv_len, cos, sin, write_index):
         h, kv, layer = carry
         attn_out, kv = Attention(
             self.config, self.dtypes, self.attn_impl, self.mesh, self.chunked,
-            self.row_frontier, self.fused_qkv, name="attn",
+            self.row_frontier, self.fused_qkv, self.quantized, name="attn",
         )(
             RMSNorm(self.config.rms_norm_eps, self.dtypes, name="input_norm")(h),
             kv, layer, kv_start, kv_len, cos, sin, write_index,
         )
         h = h + attn_out
-        h = h + MLP(self.config, self.dtypes, self.fused_qkv, name="mlp")(
+        h = h + MLP(
+            self.config, self.dtypes, self.fused_qkv, self.quantized, name="mlp"
+        )(
             RMSNorm(self.config.rms_norm_eps, self.dtypes, name="post_attn_norm")(h)
         )
         return (h, kv, layer + 1), None
@@ -485,6 +529,7 @@ class LlamaModel(nn.Module):
     chunked: bool = False  # see Attention.chunked (long-prompt prefill)
     row_frontier: bool = False  # see Attention.row_frontier (continuous batching)
     fused_qkv: bool = False  # see Attention.fused_qkv (tp=1 fused projections)
+    quantized: bool = False  # see Attention.quantized (weight-only int8 serving)
 
     @nn.compact
     def __call__(
@@ -498,13 +543,31 @@ class LlamaModel(nn.Module):
         last_logit_only: bool = False,
     ) -> Tuple[jax.Array, KVCache]:
         c, dt = self.config, self.dtypes
-        embedding = self.param(
-            "embedding",
-            nn.initializers.normal(stddev=0.02),
-            (c.vocab_size, c.hidden_size),
-            dt.param_dtype,
-        )
-        h = jnp.take(embedding, tokens, axis=0).astype(dt.compute_dtype)
+        if self.quantized and c.tie_word_embeddings:
+            # tied head: the [V, D] table is re-read IN FULL by every decode
+            # step's logit matmul, so it gets the int8 treatment too (per-row
+            # scales serve both the gather and the logits epilogue below)
+            embedding = self.param(
+                "embedding_q", nn.initializers.zeros,
+                (c.vocab_size, c.hidden_size), jnp.int8,
+            )
+            emb_scale = self.param(
+                "embedding_scale", nn.initializers.ones, (c.vocab_size,), jnp.float32
+            )
+            h = (
+                jnp.take(embedding, tokens, axis=0).astype(dt.compute_dtype)
+                * jnp.take(emb_scale, tokens, axis=0)[..., None].astype(dt.compute_dtype)
+            )
+        else:
+            # untied (or unquantized): the embedding is only ever GATHERED
+            # ([B, S] rows per step), so int8 would save no bandwidth
+            embedding = self.param(
+                "embedding",
+                nn.initializers.normal(stddev=0.02),
+                (c.vocab_size, c.hidden_size),
+                dt.param_dtype,
+            )
+            h = jnp.take(embedding, tokens, axis=0).astype(dt.compute_dtype)
 
         cos, sin = rope_cos_sin(positions, rope_frequencies(c))
 
@@ -518,7 +581,7 @@ class LlamaModel(nn.Module):
         )
         (h, (new_k, new_v), _), _ = ScanBlocks(
             c, dt, self.attn_impl, self.mesh, self.chunked, self.row_frontier,
-            self.fused_qkv, name="layers",
+            self.fused_qkv, self.quantized, name="layers",
         )(
             (h, (cache.k, cache.v), jnp.int32(0)), kv_start, kv_len, cos, sin, write_index
         )
@@ -532,6 +595,23 @@ class LlamaModel(nn.Module):
             logits = jnp.einsum(
                 "bsd,vd->bsv", h, embedding.astype(dt.compute_dtype),
                 preferred_element_type=jnp.float32,
+            )
+            if self.quantized:
+                logits = logits * emb_scale[None, None, :]
+        elif self.quantized:
+            head = self.param(
+                "lm_head_q", nn.initializers.zeros,
+                (c.hidden_size, c.vocab_size), jnp.int8,
+            )
+            head_scale = self.param(
+                "lm_head_scale", nn.initializers.ones, (c.vocab_size,), jnp.float32
+            )
+            logits = (
+                jnp.einsum(
+                    "bsd,dv->bsv", h, head.astype(dt.compute_dtype),
+                    preferred_element_type=jnp.float32,
+                )
+                * head_scale[None, None, :]
             )
         else:
             head = self.param(
@@ -597,6 +677,64 @@ def fuse_llama_params(params: dict) -> dict:
         "w_down": mlp["w_down"],
     }
     return fused
+
+
+def _quantize_leaf(w: jax.Array, axis: int, donate: bool):
+    """Symmetric per-output-channel int8: reduce |w| over the contracted
+    ``axis``, keep fp32 scales. Runs jitted on device so a multi-GB bf16
+    tree never round-trips to host; int8 output is the only new buffer."""
+
+    def q(w):
+        wf = w.astype(jnp.float32)
+        scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=axis) / 127.0, 1e-8)
+        kq = jnp.round(wf / jnp.expand_dims(scale, axis)).astype(jnp.int8)
+        return kq, scale
+
+    return jax.jit(q, donate_argnums=0 if donate else ())(w)
+
+
+def quantize_llama_params(params: dict, donate: bool = False) -> dict:
+    """bf16 tree → weight-only int8 tree (the ``LlamaModel(quantized=True)``
+    layout): every projection kernel becomes ``{kernel_q int8, scale fp32}``;
+    the tied embedding (re-read in full by each decode step's logit matmul)
+    or the untied ``lm_head`` likewise; norms and an untied embedding (gather
+    -only traffic) stay bf16. Composes with :func:`fuse_llama_params` in
+    either order — per-output-channel scales are preserved by concatenation
+    along the output axis. Like the fuser, pass-through leaves are reused,
+    not copied; with ``donate=True`` the bf16 source kernels are donated
+    (freed immediately — ONLY safe when the caller holds the sole reference
+    and drops it; the engine deliberately passes donate=False because param
+    trees are legitimately shared across engine instances).
+
+    Serving-only (the reference never trains either — rag.py:172): int8
+    params are not differentiable; keep the bf16 tree for training.
+    """
+
+    def q_group(group: dict, axis: int) -> dict:
+        out = {}
+        for name, sub in group.items():
+            if isinstance(sub, dict) and "kernel" in sub:
+                kq, scale = _quantize_leaf(sub["kernel"], axis, donate)
+                out[name] = {"kernel_q": kq, "qscale": scale}
+            else:
+                out[name] = sub  # norms etc.
+        return out
+
+    quant = dict(params)
+    layers = dict(params["layers"])
+    # stacked [L, in, out] kernels contract over axis -2
+    layers["attn"] = q_group(params["layers"]["attn"], axis=-2)
+    layers["mlp"] = q_group(params["layers"]["mlp"], axis=-2)
+    quant["layers"] = layers
+    if "lm_head" in params:  # untied: [D, V], contract over D
+        kq, scale = _quantize_leaf(params["lm_head"], axis=0, donate=donate)
+        del quant["lm_head"]
+        quant["lm_head_q"], quant["lm_head_scale"] = kq, scale
+    else:  # tied: [V, D] rows are the logit output channels
+        kq, scale = _quantize_leaf(params["embedding"], axis=1, donate=donate)
+        del quant["embedding"]
+        quant["embedding_q"], quant["embedding_scale"] = kq, scale
+    return quant
 
 
 def init_llama_params(

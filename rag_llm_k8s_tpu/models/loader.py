@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
+from rag_llm_k8s_tpu.parallel.sharding import is_quant_leaf
 
 _LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)$")
 
@@ -76,11 +77,31 @@ def _to_numpy(t) -> np.ndarray:
     return np.asarray(t)
 
 
+def _quantize_np(arr: np.ndarray, axis: int):
+    """Host-side symmetric per-output-channel int8 (the numpy twin of
+    ``models.llama._quantize_leaf``). Stacked ``[L, in, out]`` groups process
+    one layer at a time so the fp32 transient is one layer, not the group."""
+    if arr.ndim == 3:
+        assert axis == 1
+        out_q = np.empty(arr.shape, np.int8)
+        scales = np.empty((arr.shape[0], arr.shape[2]), np.float32)
+        for layer in range(arr.shape[0]):
+            w = arr[layer].astype(np.float32)
+            s = np.maximum(np.abs(w).max(axis=0) / 127.0, 1e-8)
+            out_q[layer] = np.round(w / s)
+            scales[layer] = s
+        return out_q, scales
+    w = arr.astype(np.float32)
+    s = np.maximum(np.abs(w).max(axis=axis) / 127.0, 1e-8).astype(np.float32)
+    return np.round(w / np.expand_dims(s, axis)).astype(np.int8), s
+
+
 def convert_hf_state_dict(
     state_dict,
     config: LlamaConfig,
     dtypes: DTypePolicy = DTypePolicy(),
     put: Optional[Callable[[tuple, np.ndarray], jax.Array]] = None,
+    quant: str = "bf16",
 ) -> dict:
     """Convert a flat HF llama state dict into the framework's param pytree.
 
@@ -93,9 +114,35 @@ def convert_hf_state_dict(
     ``put(path, array)`` controls device placement (e.g. ``device_put`` with a
     NamedSharding looked up from ``parallel.sharding``); default is host->
     default-device with dtype cast to ``dtypes.param_dtype``.
+
+    ``quant="int8"`` quantizes each projection kernel (and the logit head —
+    tied or untied) HOST-SIDE before placement, emitting the
+    ``LlamaModel(quantized=True)`` layout (``kernel_q``/``qscale``). This is
+    how 8B fits ONE 16 GB chip: bf16 kernels never exist on device, and the
+    transfer ships half the bytes. Norm scales and an untied embedding stay
+    ``param_dtype``.
     """
+    if quant not in ("bf16", "int8"):
+        raise ValueError(f"quant={quant!r}: expected 'bf16' or 'int8'")
     if put is None:
-        put = lambda path, arr: jnp.asarray(arr, dtype=dtypes.param_dtype)  # noqa: E731
+        put = lambda path, arr: jnp.asarray(  # noqa: E731
+            arr,
+            dtype=None if is_quant_leaf(path) else dtypes.param_dtype,
+        )
+
+    def place(path: tuple, arr: np.ndarray, quant_axis: Optional[int]):
+        """Emit one framework parameter: verbatim, or as its int8 pair."""
+        if quant == "int8" and quant_axis is not None:
+            kq, scales = _quantize_np(arr, quant_axis)
+            del arr
+            if path[-1] == "kernel":
+                q_path, s_path = path[:-1] + ("kernel_q",), path[:-1] + ("qscale",)
+            else:  # top-level: lm_head / embedding
+                q_path, s_path = (path[0] + "_q",), (path[0] + "_scale",)
+            assign(params, q_path, put(q_path, kq))
+            assign(params, s_path, put(s_path, scales))
+        else:
+            assign(params, path, put(path, arr))
 
     L = config.num_layers
 
@@ -132,7 +179,13 @@ def convert_hf_state_dict(
         arr = _to_numpy(state_dict[name])
         if transpose:
             arr = arr.T
-        assign(params, path, put(path, arr))
+        if path == ("lm_head",):  # [D, V]: logit channels are vocab columns
+            qaxis = 0
+        elif path == ("embedding",) and config.tie_word_embeddings:
+            qaxis = 1  # tied [V, D]: rows double as logit output channels
+        else:
+            qaxis = None  # untied embedding (gather-only) and norms stay bf16
+        place(path, arr, qaxis)
         del arr
 
     for suffix, (sub_path, transpose) in _LAYER_MAP.items():
@@ -143,7 +196,8 @@ def convert_hf_state_dict(
             layers.append(arr.T if transpose else arr)
         stacked = np.stack(layers, axis=0)
         del layers
-        assign(params, path, put(path, stacked))
+        # stacked [L, in, out] projection kernels contract over axis 1
+        place(path, stacked, 1 if path[-1] == "kernel" else None)
         del stacked
 
     return params
@@ -182,14 +236,18 @@ def load_safetensors_params(
     config: LlamaConfig,
     dtypes: DTypePolicy = DTypePolicy(),
     put: Optional[Callable[[tuple, np.ndarray], jax.Array]] = None,
+    quant: str = "bf16",
 ) -> dict:
     """Read every ``*.safetensors`` shard under ``model_dir`` (the PVC layout
     staged by download_model.py) and build the sharded param tree, streaming
-    tensor-by-tensor to device."""
+    tensor-by-tensor to device. ``quant="int8"`` streams the weight-only
+    int8 layout instead (see :func:`convert_hf_state_dict`)."""
     files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
     if not files:
         raise FileNotFoundError(f"no .safetensors files under {model_dir}")
-    return convert_hf_state_dict(_LazyStateDict(files), config, dtypes, put=put)
+    return convert_hf_state_dict(
+        _LazyStateDict(files), config, dtypes, put=put, quant=quant
+    )
 
 
 # ---------------------------------------------------------------------------

