@@ -89,9 +89,17 @@ def build_service():
         }
         return traverse_util.unflatten_dict(flat)
 
-    from rag_llm_k8s_tpu.models.checkpoint import load_params_cached
+    from rag_llm_k8s_tpu.models.checkpoint import CACHE_SUBDIR, load_params_cached
 
-    params = load_params_cached(model_dir, _convert, abstract_params_fn=_abstract)
+    # the cache holds whichever layout was converted — key it by quant mode
+    # so toggling TPU_RAG_WEIGHT_QUANT swaps caches instead of tripping a
+    # structure-mismatch restore failure and a full reconversion
+    cache_dir = os.path.join(
+        model_dir, CACHE_SUBDIR if quant == "bf16" else f"{CACHE_SUBDIR}_{quant}"
+    )
+    params = load_params_cached(
+        model_dir, _convert, abstract_params_fn=_abstract, cache_dir=cache_dir
+    )
     llm_tokenizer = load_tokenizer(model_dir)
 
     logger.info("loading bge-m3 from %s", config.server.embedder_path)

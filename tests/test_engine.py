@@ -111,6 +111,28 @@ class TestSampling:
         assert t1.tolist() == t2.tolist()  # deterministic given seed
         assert t1.tolist() == [1, 1, 1, 1]  # overwhelming mass on token 1
 
+    def test_sampled_tokens_stay_inside_nucleus(self):
+        """Contract: sample_token never emits a token the top_p_filter mask
+        excludes, across many seeds and both batch rows."""
+        r = np.random.default_rng(0)
+        logits = jnp.asarray(r.standard_normal((2, 500)) * 3, jnp.float32)
+        s = SamplingConfig(temperature=0.7, top_p=0.9)
+        exact_kept = np.asarray(top_p_filter(logits / s.temperature, s.top_p)) > -1e8
+        for seed in range(50):
+            toks = np.asarray(sample_token(jax.random.PRNGKey(seed), logits, s))
+            for b in range(2):
+                assert exact_kept[b, toks[b]], (seed, b, int(toks[b]))
+
+    def test_wide_flat_nucleus_spreads_draws(self):
+        """A uniform distribution keeps ~top_p of the vocab in the nucleus;
+        draws must spread across it, not collapse onto a few tokens."""
+        V = 4096  # uniform: nucleus at 0.9 is ~3686 tokens
+        logits = jnp.zeros((1, V), jnp.float32)
+        s = SamplingConfig(temperature=1.0, top_p=0.9)
+        toks = [int(sample_token(jax.random.PRNGKey(i), logits, s)[0]) for i in range(20)]
+        assert all(0 <= t < V for t in toks)
+        assert len(set(toks)) > 10
+
     def test_eos_truncation(self, tiny_engine):
         """Post-EOS tokens are trimmed host-side; outputs never contain EOS."""
         cfg, _, eng = tiny_engine

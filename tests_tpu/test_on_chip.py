@@ -265,3 +265,57 @@ class Test8BShapesOnChip:
             f"\n8B-L1 on chip: compile {compile_s:.1f}s, "
             f"prefill4096+32tok {e2e_s * 1e3:.0f} ms (warm {e2e2_s * 1e3:.0f} ms)"
         )
+
+    def test_full_depth_8b_int8_serves_on_one_chip(self):
+        """The WHOLE 32-layer 8B model on ONE v5e chip via weight-only int8
+        (~8.0 GiB weights vs ~15 GiB bf16): builds the quantized-layout tree
+        at true shapes, runs prefill + greedy decode through the production
+        engine, and records decode throughput. This is the artifact behind
+        docs/8B.md's single-chip serving claim — the reference's actual
+        model scale (download_model.py:5) executing end-to-end on hardware
+        the bf16 layout cannot fit."""
+        import time
+
+        import jax.numpy as jnp
+
+        from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
+        from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+        from rag_llm_k8s_tpu.models.llama import (
+            init_llama_params,
+            quantize_llama_params,
+        )
+
+        cfg = LlamaConfig.llama_3_1_8b()
+        DT = DTypePolicy()
+        shapes = jax.eval_shape(lambda: init_llama_params(jax.random.PRNGKey(0), cfg, DT))
+        qshapes = jax.eval_shape(quantize_llama_params, shapes)
+        params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), qshapes)
+        weight_gib = sum(
+            x.size * x.dtype.itemsize for x in jax.tree.leaves(params)
+        ) / 2**30
+        assert weight_gib < 9.0, f"int8 8B should be ~8 GiB, got {weight_gib:.2f}"
+
+        B, S, NEW = 8, 128, 64
+        eng = InferenceEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=NEW),
+            engine_config=EngineConfig(
+                prompt_buckets=(S,), max_batch_size=B, weight_quant="int8"
+            ),
+            dtypes=DT,
+        )
+        assert eng.model.quantized  # pass-through: tree already int8
+        prompts = [[cfg.bos_token_id] * S] * B
+        t0 = time.monotonic()
+        eng.warmup(batch_sizes=(B,), buckets=(S,))
+        compile_s = time.monotonic() - t0
+        outs = eng.generate(prompts)
+        assert all(len(o) == NEW for o in outs)
+        t0 = time.monotonic()
+        outs = eng.generate(prompts)
+        tok_s = sum(len(o) for o in outs) / (time.monotonic() - t0)
+        print(
+            f"\n8B int8 FULL DEPTH on one chip: {weight_gib:.2f} GiB weights, "
+            f"compile {compile_s:.1f}s, decode {tok_s:.0f} tok/s (B={B})"
+        )
+        assert tok_s > 100  # sanity floor; measured ~610 at B=8
