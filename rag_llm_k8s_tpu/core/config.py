@@ -143,6 +143,32 @@ class LlamaConfig:
         )
 
     @classmethod
+    def llama_3_2_3b(cls) -> "LlamaConfig":
+        """Llama-3.2-3B — single chip in bf16 (~6.4 GB) or int8 (~3.6 GB)."""
+        return cls(
+            hidden_size=3072,
+            intermediate_size=8192,
+            num_layers=28,
+            num_heads=24,
+            num_kv_heads=8,
+            head_dim=128,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def llama_3_1_70b(cls) -> "LlamaConfig":
+        """Llama-3.1-70B — a tp=8 (v5e-8, int8: ~9 GB/chip) or multi-host
+        deployment; every sharded dim divides tp=8 exactly like 8B."""
+        return cls(
+            hidden_size=8192,
+            intermediate_size=28672,
+            num_layers=80,
+            num_heads=64,
+            num_kv_heads=8,
+            head_dim=128,
+        )
+
+    @classmethod
     def tiny(cls, vocab_size: int = 256) -> "LlamaConfig":
         """Miniature config for CPU tests: same code paths, toy shapes."""
         return cls(

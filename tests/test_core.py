@@ -47,6 +47,40 @@ class TestReferenceParityDefaults:
         assert m.rope_theta == 500000.0
         assert m.rope_scaling.factor == 8.0
 
+    def test_llama_family_parameter_counts(self):
+        """Config shapes reproduce each family member's published size —
+        the invariant that guards against transcription slips in the
+        classmethods (checked analytically; no tensors built)."""
+        def n_params(m):
+            attn = m.num_heads * m.head_dim + 2 * m.num_kv_heads * m.head_dim
+            per_layer = (
+                m.hidden_size * attn                      # wq wk wv
+                + m.num_heads * m.head_dim * m.hidden_size  # wo
+                + 3 * m.hidden_size * m.intermediate_size   # gate up down
+                + 2 * m.hidden_size                          # norms
+            )
+            total = m.num_layers * per_layer + m.hidden_size
+            total += m.vocab_size * m.hidden_size  # embedding
+            if not m.tie_word_embeddings:
+                total += m.vocab_size * m.hidden_size  # lm_head
+            return total
+
+        # published sizes (billions): 1.24, 3.21, 8.03, 70.6
+        for cfg, want_b in [
+            (LlamaConfig.llama_3_2_1b(), 1.24),
+            (LlamaConfig.llama_3_2_3b(), 3.21),
+            (LlamaConfig.llama_3_1_8b(), 8.03),
+            (LlamaConfig.llama_3_1_70b(), 70.6),
+        ]:
+            got_b = n_params(cfg) / 1e9
+            assert abs(got_b - want_b) / want_b < 0.01, (cfg, got_b, want_b)
+
+    def test_70b_dims_divide_tp8(self):
+        m = LlamaConfig.llama_3_1_70b()
+        for dim in (m.hidden_size, m.intermediate_size, m.vocab_size,
+                    m.num_heads, m.num_kv_heads):
+            assert dim % 8 == 0
+
     def test_from_env_model_path(self):
         c = AppConfig.from_env({"MODEL_PATH": "/tmp/m", "TPU_RAG_PORT": "8080"})
         assert c.server.model_path == "/tmp/m"
