@@ -176,7 +176,7 @@ def measure_query_e2e() -> dict:
     tok = WordHashTokenizer(llama_cfg.vocab_size, bos=llama_cfg.bos_token_id)
     enc_tok = WordHashTokenizer(enc_cfg.vocab_size)
 
-    def run_mode(weight_quant: str, ingest: bool):
+    def run_mode(weight_quant: str, ingest: bool, concurrency: int = 0):
         # one 4096 bucket: the reference's full 3×1000-word context (~4k
         # tokens) fits without shrinking, so the measured prefill is the
         # real RAG prompt
@@ -185,13 +185,35 @@ def measure_query_e2e() -> dict:
             llama_params,
             sampling=SamplingConfig(),  # reference parity: 150 new, 0.7/0.9
             engine_config=EngineConfig(
-                prompt_buckets=(4096,), max_batch_size=4, weight_quant=weight_quant
+                prompt_buckets=(4096,),
+                max_batch_size=max(4, concurrency),
+                weight_quant=weight_quant,
             ),
             dtypes=dtypes,
         )
-        service = RagService(app_cfg, engine, tok, encoder, enc_tok, store)
+        scheduler = None
+        if concurrency:
+            # under-load mode: concurrent requests coalesce into batched
+            # generate calls (BASELINE config #5). The COALESCING scheduler
+            # is measured rather than the continuous one because the
+            # continuous engine syncs the host once per decode step — μs on
+            # a normally-attached TPU, ~200 ms over this harness's tunnel
+            # (see the environment note above), which would measure the
+            # tunnel, not the batching design.
+            from rag_llm_k8s_tpu.engine.batching import BatchScheduler
+
+            # the coalescing window must cover the ARRIVAL SPREAD of the
+            # concurrent burst: each request's embed+kNN fetch serializes on
+            # the tunnel (~250 ms apiece here), so 30 ms — a sane production
+            # window — would coalesce nothing in this harness and every
+            # query would decode alone
+            scheduler = BatchScheduler(engine, max_wait_ms=1500.0)
+        service = RagService(
+            app_cfg, engine, tok, encoder, enc_tok, store, scheduler=scheduler
+        )
         service.warmup()
-        client = create_app(service).test_client()
+        app = create_app(service)
+        client = app.test_client()
 
         ingest_s = None
         if ingest:
@@ -212,6 +234,46 @@ def measure_query_e2e() -> dict:
         client.post("/query", json={"prompt": QUERIES[0]})  # warm end to end
         lat_ms = []
         stages = {"tokenize_ms": [], "embed_retrieve_ms": [], "generate_ms": []}
+
+        if concurrency:
+            import threading
+
+            lock = threading.Lock()
+            jobs = list(QUERIES) + list(QUERIES[: max(0, 2 * concurrency - len(QUERIES))])
+            errors = []
+
+            def worker(queries):
+                c = app.test_client()  # test clients are not thread-safe
+                try:
+                    for q in queries:
+                        t0 = time.monotonic()
+                        r = c.post("/query", json={"prompt": q})
+                        dt_ms = (time.monotonic() - t0) * 1e3
+                        assert r.status_code == 200, r.get_data()
+                        with lock:
+                            lat_ms.append(dt_ms)
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    with lock:
+                        errors.append(e)
+
+            threads = [
+                threading.Thread(target=worker, args=(jobs[i::concurrency],))
+                for i in range(concurrency)
+            ]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall_s = time.monotonic() - t0
+            if errors:
+                # a swallowed worker failure would leave qps computed over
+                # jobs that never ran — fail the bench loudly instead
+                raise errors[0]
+            scheduler.shutdown()
+            lat_ms.sort()
+            return lat_ms, {"qps": len(jobs) / wall_s, "n": len(jobs)}, None
+
         for q in QUERIES:
             t0 = time.monotonic()
             r = client.post("/query", json={"prompt": q})
@@ -225,11 +287,18 @@ def measure_query_e2e() -> dict:
 
     lat_ms, stages, ingest_s = run_mode("bf16", ingest=True)
     lat_int8, _, _ = run_mode("int8", ingest=False)  # same index, same queries
+    lat_load, load_info, _ = run_mode("bf16", ingest=False, concurrency=8)
     n = len(lat_ms)
     return {
         "query_p50_ms": round(lat_ms[n // 2], 1),
         "query_p95_ms": round(lat_ms[max(0, math.ceil(n * 0.95) - 1)], 1),
         "query_p50_int8_ms": round(lat_int8[len(lat_int8) // 2], 1),
+        # aggregate serving throughput: concurrent requests coalesced into
+        # batched generates — the reference serves strictly one-at-a-time
+        # (rag.py:204), so its qps is 1 / its per-query latency
+        "query_qps_load": round(load_info["qps"], 2),
+        "query_p50_load_ms": round(lat_load[len(lat_load) // 2], 1),
+        "query_load_concurrency": 8,
         "query_stage_ms": {
             k.removesuffix("_ms"): round(sum(v) / len(v), 1) for k, v in stages.items()
         },
@@ -293,6 +362,62 @@ def measure_tpu() -> dict:
     sweep = {b: round(run(b), 1) for b in SWEEP_BATCHES}
     int8 = {b: round(run(b, "int8"), 1) for b in (1, BATCH)}
     return {"tok_per_s": sweep[BATCH], "sweep": sweep, "int8": int8}
+
+
+def measure_longctx() -> dict:
+    """Long-context decode: per-step latency with a 4096-token prompt bucket
+    (the engine rounds the cache to T=4224 slots for these runs), where the
+    cache scan is a third of step bandwidth — the regime the int8 KV cache
+    (``EngineConfig.kv_quant``) exists for. Decode-only: a 2-token run's
+    wall time (≈ prefill) is subtracted from a 66-token run's."""
+    import jax
+    import jax.numpy as jnp
+
+    from rag_llm_k8s_tpu.core.config import (
+        DTypePolicy,
+        EngineConfig,
+        LlamaConfig,
+        SamplingConfig,
+    )
+    from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+    from rag_llm_k8s_tpu.models.llama import init_llama_params
+
+    config = LlamaConfig.llama_3_2_1b()
+    dtypes = DTypePolicy()
+    shapes = jax.eval_shape(lambda: init_llama_params(jax.random.PRNGKey(0), config, dtypes))
+    params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    B, BUCKET, LONG, SHORT = 8, 4096, 66, 2
+
+    def best_time(kvq: str, new: int) -> float:
+        engine = InferenceEngine(
+            config, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=new),
+            engine_config=EngineConfig(
+                prompt_buckets=(BUCKET,), max_batch_size=B, kv_quant=kvq
+            ),
+            dtypes=dtypes,
+        )
+        prompts = [[config.bos_token_id] * BUCKET] * B
+        engine.warmup(batch_sizes=(B,), buckets=(BUCKET,), max_new_tokens=new)
+        engine.generate(prompts, max_new_tokens=new)
+        best = 1e9
+        for _ in range(3):
+            t0 = time.monotonic()
+            engine.generate(prompts, max_new_tokens=new)
+            best = min(best, time.monotonic() - t0)
+        return best
+
+    out = {}
+    for kvq in ("bf16", "int8"):
+        step_ms = (best_time(kvq, LONG) - best_time(kvq, SHORT)) / (LONG - SHORT) * 1e3
+        out[kvq] = round(step_ms, 2)
+    return {
+        "longctx_decode_step_ms": out,
+        # the cache length the engine actually allocates and every decode
+        # step actually scans for these runs (128-rounded BUCKET + LONG)
+        "longctx_T": -(-(BUCKET + LONG) // 128) * 128,
+        "longctx_batch": B,
+    }
 
 
 def measure_8b_int8() -> dict:
@@ -377,6 +502,7 @@ def main():
     baseline = get_cpu_baseline()
     tpu = measure_tpu()
     b8 = measure_8b_int8()
+    lc = measure_longctx()
     e2e = measure_query_e2e()
     line = {
         "metric": "llama_1b_decode_throughput",
@@ -389,6 +515,7 @@ def main():
         "query_p50_target_ms": 2000,  # BASELINE.md north star: p50 < 2 s
     }
     line.update(b8)
+    line.update(lc)
     line.update(e2e)
     print(json.dumps(line))
 

@@ -294,6 +294,13 @@ class EngineConfig:
     # every decode step streams, and fits 8B weights on one 16 GB chip;
     # see models.llama.quantize_llama_params). Training always stays bf16.
     weight_quant: str = "bf16"
+    # KV-cache storage: "bf16" (exact) or "int8" (one fp32 scale per
+    # (token, kv-head) vector — halves the cache bytes every decode step
+    # scans AND the cache HBM footprint; at the full 4352-token budget the
+    # cache is ~1/3 of step bandwidth. ops.attention.decode_attention_q8 is
+    # the kernel; parity bounds in tests. One-shot engine only — the
+    # continuous engine's row-insert path stays bf16.)
+    kv_quant: str = "bf16"
 
 
 @dataclass(frozen=True)
@@ -390,6 +397,13 @@ class AppConfig:
                     f"TPU_RAG_WEIGHT_QUANT={wq!r}: expected 'bf16' or 'int8'"
                 )
             engine = dataclasses.replace(engine, weight_quant=wq)
+        if "TPU_RAG_KV_QUANT" in env:
+            kvq = env["TPU_RAG_KV_QUANT"]
+            if kvq not in ("bf16", "int8"):
+                raise ValueError(
+                    f"TPU_RAG_KV_QUANT={kvq!r}: expected 'bf16' or 'int8'"
+                )
+            engine = dataclasses.replace(engine, kv_quant=kvq)
         return dataclasses.replace(
             cfg, server=server, mesh=mesh, sampling=sampling, engine=engine
         )

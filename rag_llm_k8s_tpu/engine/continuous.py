@@ -107,6 +107,14 @@ class ContinuousEngine:
                 f"max_seq_len={engine_config.max_seq_len} (slot length {self.T})"
             )
         jmesh = mesh.mesh if mesh is not None and mesh.tp > 1 else None
+        if engine_config.kv_quant != "bf16":
+            # the row-insert executables donate and rebuild per-row cache
+            # slices; extending them to the (payload, scale) pair is tracked
+            # work — serve int8-KV through InferenceEngine meanwhile
+            raise NotImplementedError(
+                "kv_quant='int8' is one-shot-engine only; the continuous "
+                "engine's KV cache stays bf16"
+            )
         self.params, fused = maybe_fuse_params(params, engine_config, mesh)
         self.params, quantized = maybe_quantize_params(self.params, engine_config)
         self.model = LlamaModel(

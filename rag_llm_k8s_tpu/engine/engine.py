@@ -146,6 +146,10 @@ class InferenceEngine:
         self.dtypes = dtypes
         self.mesh = mesh
         self.pad_id = pad_id
+        if engine_config.kv_quant not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_quant={engine_config.kv_quant!r}: expected 'bf16' or 'int8'"
+            )
         self.params, fused = maybe_fuse_params(params, engine_config, mesh)
         self.params, quantized = maybe_quantize_params(self.params, engine_config)
         self.model = LlamaModel(
@@ -155,6 +159,7 @@ class InferenceEngine:
             mesh=(mesh.mesh if mesh is not None and mesh.tp > 1 else None),
             fused_qkv=fused,
             quantized=quantized,
+            kv_quant=engine_config.kv_quant,
         )
         # same params, STATIC chunked=True: prompts longer than the largest
         # bucket prefill through the cache chunk by chunk (offset-causal
@@ -221,7 +226,9 @@ class InferenceEngine:
             )
 
         def gen(params, tokens, pad_mask, rng):
-            cache = make_kv_cache(cfg, B, T, cache_dtype)
+            cache = make_kv_cache(
+                cfg, B, T, cache_dtype, quant=self.engine_config.kv_quant
+            )
             kv_start, _ = mask_window(pad_mask)  # left-pad: [S - real_len, S)
             real_len = jnp.sum(pad_mask, axis=-1)  # [B]
             positions = jnp.clip(jnp.cumsum(pad_mask, axis=-1) - 1, 0)

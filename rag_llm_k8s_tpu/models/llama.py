@@ -41,8 +41,12 @@ from rag_llm_k8s_tpu.ops.attention import (
     chunk_attention_xla,
     chunk_prefill_attention,
     decode_attention,
+    decode_attention_q8,
     decode_attention_xla,
+    decode_attention_xla_q8,
+    dequantize_layer_slice,
     flash_attention,
+    quantize_kv,
 )
 
 # ---------------------------------------------------------------------------
@@ -61,10 +65,17 @@ class KVCache:
     appends at the same ``write_index`` — cache updates stay a
     ``dynamic_update_slice`` (scatter-free, MXU/DMA friendly) instead of a
     per-row scatter.
+
+    ``kv_quant="int8"`` (EngineConfig): ``k``/``v`` hold int8 payloads and
+    ``k_scale``/``v_scale`` ``[L, B, kv_heads, T_max]`` fp32 carry one
+    symmetric scale per (token, head) vector — half the cache bytes per
+    decode-step scan and half the HBM footprint. ``None`` on the bf16 path.
     """
 
     k: jax.Array
     v: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
 
 def make_kv_cache(
@@ -72,6 +83,7 @@ def make_kv_cache(
     batch_size: int,
     max_seq_len: int,
     dtype: jnp.dtype = jnp.bfloat16,
+    quant: str = "bf16",
 ) -> KVCache:
     shape = (
         config.num_layers,
@@ -80,6 +92,14 @@ def make_kv_cache(
         max_seq_len,
         config.head_dim,
     )
+    if quant == "int8":
+        return KVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+            v_scale=jnp.zeros(shape[:-1], jnp.float32),
+        )
+    assert quant == "bf16", f"kv_quant={quant!r}: expected 'bf16' or 'int8'"
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -232,6 +252,10 @@ class Attention(nn.Module):
     # STATIC weight-only int8 switch: projections read QuantDense params
     # ({kernel_q, scale} from quantize_llama_params) instead of bf16 kernels.
     quantized: bool = False
+    # STATIC int8-KV switch: the cache carry becomes (k, v, k_scale,
+    # v_scale); fresh K/V quantize on write (ops.attention.quantize_kv) and
+    # decode streams int8 blocks through decode_attention_q8.
+    kv_quant: str = "bf16"
 
     def _resolved_impl(self) -> str:
         if self.attn_impl not in ("auto", "pallas", "pallas_interpret", "xla"):
@@ -244,7 +268,8 @@ class Attention(nn.Module):
         return self.attn_impl
 
     def _attend(
-        self, q, k, v, kv_start, kv_len, layer, *, mode: str, write_index=None
+        self, q, k, v, kv_start, kv_len, layer, *, mode: str, write_index=None,
+        scales=None,
     ) -> jax.Array:
         """Dispatch to the right backend. ``mode``:
 
@@ -254,10 +279,27 @@ class Attention(nn.Module):
           per-layer slice is ever materialized); ``chunk`` additionally takes
           ``write_index`` — query ``t`` sits at cache slot ``write_index + t``
           (offset causality over the populated prefix).
+
+        ``scales`` (int8-KV only): ``(k_scale, v_scale) [L, B, K, T]`` fp32
+        riding alongside an int8 cache. Decode streams them through the q8
+        kernel; chunk dequantizes THIS layer's slice to bf16 (a layer slice,
+        never the stacked cache) and reuses the bf16 chunk kernel.
         """
         impl = self._resolved_impl()
         mesh = self.mesh
         cache_kv = mode in ("decode", "chunk")
+        if scales is not None and mode == "chunk":
+            # dequantized [1, B, K, T, hd] view of this layer only (shared
+            # helper with the q8 oracle), then the bf16 chunk kernel runs
+            # unchanged at layer 0 of the one-layer view
+            k = dequantize_layer_slice(
+                k, scales[0], layer, kv_start, kv_len, self.dtypes.compute_dtype
+            )
+            v = dequantize_layer_slice(
+                v, scales[1], layer, kv_start, kv_len, self.dtypes.compute_dtype
+            )
+            layer = jnp.int32(0)
+            scales = None
         # kv heads sit at dim 2 in both layouts ([L,B,K,T,hd] / [B,S,K,hd])
         H, K = q.shape[2], k.shape[2]
         tp = (
@@ -285,6 +327,10 @@ class Attention(nn.Module):
             impl = "xla"
         if impl == "xla":
             if mode == "decode":
+                if scales is not None:
+                    return decode_attention_xla_q8(
+                        q, k, v, scales[0], scales[1], kv_start, kv_len, layer
+                    )
                 return decode_attention_xla(q, k, v, kv_start, kv_len, layer)
             if mode == "chunk":
                 return chunk_attention_xla(
@@ -293,7 +339,11 @@ class Attention(nn.Module):
             return attention_xla(q, k, v, kv_start=kv_start, kv_len=kv_len, causal=True)
 
         interpret = impl == "pallas_interpret"
-        if mode == "decode":
+        if mode == "decode" and scales is not None:
+            kernel = lambda q_, k_, v_, ks_, vs_, s_, l_, lay_: decode_attention_q8(  # noqa: E731
+                q_, k_, v_, ks_, vs_, s_, l_, lay_, interpret=interpret
+            )
+        elif mode == "decode":
             kernel = lambda q_, k_, v_, s_, l_, lay_: decode_attention(  # noqa: E731
                 q_, k_, v_, s_, l_, lay_, interpret=interpret
             )
@@ -314,11 +364,12 @@ class Attention(nn.Module):
             hspec = P(None, None, "tp", None)
             if cache_kv:
                 kvspec = P(None, None, "tp", None, None)
+                scspec = (P(None, None, "tp", None),) * 2 if scales is not None else ()
                 scalars = (P(None),) * (3 if mode == "chunk" else 2)
                 kernel = shard_map(
                     kernel,
                     mesh=mesh,
-                    in_specs=(hspec, kvspec, kvspec, P(None)) + scalars,
+                    in_specs=(hspec, kvspec, kvspec) + scspec + (P(None),) + scalars,
                     out_specs=hspec,
                     check_rep=False,
                 )
@@ -331,7 +382,10 @@ class Attention(nn.Module):
                     check_rep=False,
                 )
         if mode == "decode":
-            return kernel(q, k, v, kv_start, kv_len, jnp.asarray(layer, jnp.int32).reshape(1))
+            lay1 = jnp.asarray(layer, jnp.int32).reshape(1)
+            if scales is not None:
+                return kernel(q, k, v, scales[0], scales[1], kv_start, kv_len, lay1)
+            return kernel(q, k, v, kv_start, kv_len, lay1)
         if mode == "chunk":
             return kernel(
                 q, k, v, kv_start, kv_len,
@@ -402,47 +456,69 @@ class Attention(nn.Module):
         # stacked [L, ...] cache is a scan carry, so XLA aliases it across
         # layers and decode steps — no cache-sized copy ever happens (the
         # naive per-layer-output stacking costs GB/step of pure copy traffic)
-        k_cache, v_cache = kv  # [L, B, K, T, hd]
+        q8 = self.kv_quant == "int8"
+        if q8:
+            k_cache, v_cache, ks_cache, vs_cache = kv
+            k_w, k_s = quantize_kv(k)  # [B, S, K, hd] int8, [B, S, K] fp32
+            v_w, v_s = quantize_kv(v)
+        else:
+            k_cache, v_cache = kv
+            k_w, v_w, k_s, v_s = k, v, None, None
         if self.row_frontier and S == 1:
             # continuous batching: write_index is [B] — each row's token
             # lands at that row's own frontier (one-slot-per-row scatter,
             # aliased in place under the scan carry like the slice write)
             b_idx = jnp.arange(B)
             k_cache = k_cache.at[layer, b_idx, :, write_index, :].set(
-                k[:, 0].astype(k_cache.dtype)
+                k_w[:, 0].astype(k_cache.dtype)
             )
             v_cache = v_cache.at[layer, b_idx, :, write_index, :].set(
-                v[:, 0].astype(v_cache.dtype)
+                v_w[:, 0].astype(v_cache.dtype)
             )
+            if q8:
+                ks_cache = ks_cache.at[layer, b_idx, :, write_index].set(k_s[:, 0])
+                vs_cache = vs_cache.at[layer, b_idx, :, write_index].set(v_s[:, 0])
         else:
             k_cache = jax.lax.dynamic_update_slice(
                 k_cache,
-                k.transpose(0, 2, 1, 3).astype(k_cache.dtype)[None],
+                k_w.transpose(0, 2, 1, 3).astype(k_cache.dtype)[None],
                 (layer, 0, 0, write_index, 0),
             )
             v_cache = jax.lax.dynamic_update_slice(
                 v_cache,
-                v.transpose(0, 2, 1, 3).astype(v_cache.dtype)[None],
+                v_w.transpose(0, 2, 1, 3).astype(v_cache.dtype)[None],
                 (layer, 0, 0, write_index, 0),
             )
+            if q8:
+                ks_cache = jax.lax.dynamic_update_slice(
+                    ks_cache, k_s.transpose(0, 2, 1)[None], (layer, 0, 0, write_index)
+                )
+                vs_cache = jax.lax.dynamic_update_slice(
+                    vs_cache, v_s.transpose(0, 2, 1)[None], (layer, 0, 0, write_index)
+                )
 
+        scales = (ks_cache, vs_cache) if q8 else None
         if S == 1:
-            out = self._attend(q, k_cache, v_cache, kv_start, kv_len, layer, mode="decode")
+            out = self._attend(
+                q, k_cache, v_cache, kv_start, kv_len, layer,
+                mode="decode", scales=scales,
+            )
         elif self.chunked:
             # chunked prefill: this chunk's queries attend over the WHOLE
             # populated cache prefix (earlier chunks + this one) with offset
             # causality — query t sits at cache slot write_index + t
             out = self._attend(
                 q, k_cache, v_cache, kv_start, kv_len, layer,
-                mode="chunk", write_index=write_index,
+                mode="chunk", write_index=write_index, scales=scales,
             )
         else:
             # single-shot prefill/training writes at slot 0, so the fresh K/V
             # ARE the populated cache prefix — attend over S keys, not T cache
-            # slots. The check is concrete-only: under tracing (nn.scan
-            # broadcasts every argument as a tracer, as do init/eval_shape/
-            # grad) the value can't be inspected, and every in-tree caller
-            # passes 0 for non-chunked multi-token calls.
+            # slots (always bf16: quantization touches only the cache). The
+            # check is concrete-only: under tracing (nn.scan broadcasts every
+            # argument as a tracer, as do init/eval_shape/grad) the value
+            # can't be inspected, and every in-tree caller passes 0 for
+            # non-chunked multi-token calls.
             if not isinstance(write_index, jax.core.Tracer):
                 assert int(write_index) == 0, (
                     "multi-token calls must write at slot 0 — build the model "
@@ -450,7 +526,10 @@ class Attention(nn.Module):
                 )
             out = self._attend(q, k, v, kv_start, kv_len, layer, mode="prefill")
         out = out.astype(dt.compute_dtype).reshape(B, S, H * hd)
-        return dense(D, "wo")(out), (k_cache, v_cache)
+        new_kv = (
+            (k_cache, v_cache, ks_cache, vs_cache) if q8 else (k_cache, v_cache)
+        )
+        return dense(D, "wo")(out), new_kv
 
 
 class MLP(nn.Module):
@@ -486,13 +565,15 @@ class Block(nn.Module):
     row_frontier: bool = False
     fused_qkv: bool = False
     quantized: bool = False
+    kv_quant: str = "bf16"
 
     @nn.compact
     def __call__(self, carry, kv_start, kv_len, cos, sin, write_index):
         h, kv, layer = carry
         attn_out, kv = Attention(
             self.config, self.dtypes, self.attn_impl, self.mesh, self.chunked,
-            self.row_frontier, self.fused_qkv, self.quantized, name="attn",
+            self.row_frontier, self.fused_qkv, self.quantized, self.kv_quant,
+            name="attn",
         )(
             RMSNorm(self.config.rms_norm_eps, self.dtypes, name="input_norm")(h),
             kv, layer, kv_start, kv_len, cos, sin, write_index,
@@ -530,6 +611,7 @@ class LlamaModel(nn.Module):
     row_frontier: bool = False  # see Attention.row_frontier (continuous batching)
     fused_qkv: bool = False  # see Attention.fused_qkv (tp=1 fused projections)
     quantized: bool = False  # see Attention.quantized (weight-only int8 serving)
+    kv_quant: str = "bf16"  # see Attention.kv_quant (int8 KV cache)
 
     @nn.compact
     def __call__(
@@ -579,12 +661,21 @@ class LlamaModel(nn.Module):
             out_axes=0,
             length=c.num_layers,
         )
-        (h, (new_k, new_v), _), _ = ScanBlocks(
+        if self.kv_quant == "int8":
+            assert cache.k_scale is not None, (
+                "kv_quant='int8' needs an int8 cache — build it with "
+                "make_kv_cache(..., quant='int8')"
+            )
+            kv_in = (cache.k, cache.v, cache.k_scale, cache.v_scale)
+        else:
+            kv_in = (cache.k, cache.v)
+        (h, new_kv, _), _ = ScanBlocks(
             c, dt, self.attn_impl, self.mesh, self.chunked, self.row_frontier,
-            self.fused_qkv, self.quantized, name="layers",
+            self.fused_qkv, self.quantized, self.kv_quant, name="layers",
         )(
-            (h, (cache.k, cache.v), jnp.int32(0)), kv_start, kv_len, cos, sin, write_index
+            (h, kv_in, jnp.int32(0)), kv_start, kv_len, cos, sin, write_index
         )
+        new_cache = KVCache(*new_kv)
 
         h = RMSNorm(c.rms_norm_eps, dt, name="final_norm")(h)
         if last_logit_only:
@@ -624,7 +715,7 @@ class LlamaModel(nn.Module):
                 "bsd,dv->bsv", h, head.astype(dt.compute_dtype),
                 preferred_element_type=jnp.float32,
             )
-        return logits.astype(dt.logits_dtype), KVCache(k=new_k, v=new_v)
+        return logits.astype(dt.logits_dtype), new_cache
 
 
 # ---------------------------------------------------------------------------

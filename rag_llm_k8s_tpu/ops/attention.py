@@ -596,3 +596,223 @@ def attention_xla(
     o = jnp.einsum("bkgqs,bskd->bqkgd", p.astype(v.dtype), v,
                    preferred_element_type=jnp.float32)
     return o.reshape(B, Sq, H, hd).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# weight-only-int8 KV cache (kv_quant="int8")
+# ---------------------------------------------------------------------------
+#
+# At the engine's full cache budget the decode step's HBM traffic is weights
+# PLUS the whole populated cache (e.g. 8B, B=8, T=4352: ~8 GiB int8 weights
+# + ~4.6 GB bf16 cache per step). Storing K/V as int8 with one fp32 scale
+# per (token, kv-head) vector halves the cache bytes streamed and the cache
+# HBM footprint; dequantization happens in VMEM right after each block load,
+# so the flash recurrence and masking below are IDENTICAL to the bf16
+# kernel's. Per-vector symmetric scales bound the dequant error at
+# max|x|/254 per element — the parity tests pin logits against the bf16
+# cache path.
+
+
+def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """``[..., hd] -> (int8 [..., hd], fp32 scale [...])`` — one symmetric
+    scale per head-vector (the granularity the kernels dequantize at)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.round(xf / scale[..., None]).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_layer_slice(
+    cache: jax.Array,  # [L, B, K, T, hd] int8
+    scale: jax.Array,  # [L, B, K, T] fp32
+    layer: jax.Array,  # [] or [1] int32
+    kv_start: jax.Array,  # [B]
+    kv_len: jax.Array,  # [B]
+    dtype: jnp.dtype,
+) -> jax.Array:
+    """``[1, B, K, T, hd]`` dequantized view of ONE layer — the shared
+    slice-dequant used by the XLA q8 oracle and the chunked-prefill path
+    (a layer slice is ~MBs; the stacked cache the q8 layout exists to avoid
+    copying is GBs). Scales outside ``[kv_start, kv_len)`` zero out under
+    the window mask: slots past the frontier can be uninitialized fp32
+    memory (NaN), while the int8 payload is finite by construction, so
+    zeroed scales alone make every invalid slot contribute exactly 0."""
+    lay = jnp.asarray(layer, jnp.int32).reshape(())
+    T = cache.shape[3]
+    t_ok = (jnp.arange(T)[None, :] >= kv_start[:, None]) & (
+        jnp.arange(T)[None, :] < kv_len[:, None]
+    )
+    c = jax.lax.dynamic_index_in_dim(cache, lay, 0, keepdims=False)
+    s = jax.lax.dynamic_index_in_dim(scale, lay, 0, keepdims=False)
+    s = jnp.where(t_ok[:, None, :], s, 0.0)
+    return (c.astype(jnp.float32) * s[..., None]).astype(dtype)[None]
+
+
+def _decode_kernel_q8(
+    layer_ref,  # SMEM [1] (consumed by the index maps)
+    kv_start_ref,  # SMEM [B]
+    kv_len_ref,  # SMEM [B]
+    q_ref,  # [1, K, G, hd]
+    k_ref,  # [1, 1, K, bk, hd] int8
+    v_ref,  # [1, 1, K, bk, hd] int8
+    ks_ref,  # [1, 1, K, bk] fp32
+    vs_ref,  # [1, 1, K, bk] fp32
+    o_ref,  # [1, K, G, hd]
+    m_scr,  # VMEM [K, G, 1]
+    l_scr,  # VMEM [K, G, 1]
+    acc_scr,  # VMEM [K, G, hd]
+    *,
+    bk: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    kj = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    blk_lo = kj * bk
+    live = (blk_lo < kv_len_ref[b]) & (blk_lo + bk > kv_start_ref[b])
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]  # [K, G, hd]
+        # int8 payloads need NO validity masking: unlike bf16 (where an
+        # uninitialized slot can hold NaN that survives 0-weighting), every
+        # int8 bit pattern is a finite value, and invalid columns are
+        # eliminated by the score mask + zeroed scales below. The convert
+        # to the matmul dtype is the only per-element op on the payload.
+        k = k_ref[0, 0].astype(q.dtype)  # [K, bk, hd]
+        rpos = blk_lo + jax.lax.broadcasted_iota(
+            jnp.int32, (k.shape[0], bk), 1
+        )
+        rok = (rpos >= kv_start_ref[b]) & (rpos < kv_len_ref[b])
+        # scales CAN be NaN past the frontier (uninitialized fp32 memory):
+        # zero them under the window mask — [K, bk] work, not [K, bk, hd]
+        ks = jnp.where(rok, ks_ref[0, 0], 0.0)
+        vs = jnp.where(rok, vs_ref[0, 0], 0.0)
+        # dequantization rides the EPILOGUES: scores scale per key column,
+        # probabilities fold the V scale — O(K*G*bk) multiplies instead of
+        # O(K*bk*hd) on the payload (the whole point: the int8 win is
+        # bandwidth, so the kernel must not spend it back in VPU flops)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale * ks[:, None, :]
+
+        k_pos = blk_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        ok = (k_pos >= kv_start_ref[b]) & (k_pos < kv_len_ref[b])
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(ok, jnp.exp(s - m_new), 0.0)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=2, keepdims=True)
+        pv = (p * vs[:, None, :]).astype(q.dtype)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            pv, v_ref[0, 0].astype(q.dtype), (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bk", "interpret"))
+def decode_attention_q8(
+    q: jax.Array,  # [B, 1, H, hd] — the single fresh query token
+    k_cache: jax.Array,  # [L, B, K, T, hd] int8
+    v_cache: jax.Array,  # [L, B, K, T, hd] int8
+    k_scale: jax.Array,  # [L, B, K, T] fp32
+    v_scale: jax.Array,  # [L, B, K, T] fp32
+    kv_start: jax.Array,  # [B] int32
+    kv_len: jax.Array,  # [B] int32
+    layer: jax.Array,  # [] or [1] int32
+    bk: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """``decode_attention`` over an int8 KV cache (see module note above).
+
+    Same grid, masking, and streaming layout as the bf16 kernel; the only
+    addition is the two per-(token, head) scale planes riding alongside the
+    int8 payload blocks."""
+    B, S, H, hd = q.shape
+    assert S == 1, f"decode_attention_q8 is single-token (got S={S})"
+    L, _, K, T, _ = k_cache.shape
+    G = H // K
+    req_bk = bk
+    bk = _decode_block(T, bk)
+    assert T % bk == 0, (T, bk)
+    if not interpret and bk % 32:
+        # int8 blocks need a 32-row second-to-minor tile on real hardware
+        raise ValueError(
+            f"cache length T={T} only tiles into blocks of {bk} ≤ bk={req_bk}: "
+            "pad T to a multiple of 128 — the engine rounds cache lengths for this"
+        )
+
+    qh = q.reshape(B, K, G, hd)
+    grid = (B, T // bk)
+
+    def kv_index(b, kj, layer_ref, *s_):
+        return (layer_ref[0], b, 0, kj, 0)
+
+    def sc_index(b, kj, layer_ref, *s_):
+        return (layer_ref[0], b, 0, kj)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel_q8, bk=bk, scale=hd**-0.5),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, K, G, hd), lambda b, kj, *s_: (b, 0, 0, 0)),
+                pl.BlockSpec((1, 1, K, bk, hd), kv_index),
+                pl.BlockSpec((1, 1, K, bk, hd), kv_index),
+                pl.BlockSpec((1, 1, K, bk), sc_index),
+                pl.BlockSpec((1, 1, K, bk), sc_index),
+            ],
+            out_specs=pl.BlockSpec((1, K, G, hd), lambda b, kj, *s_: (b, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((K, G, 1), jnp.float32),
+                pltpu.VMEM((K, G, 1), jnp.float32),
+                pltpu.VMEM((K, G, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, K, G, hd), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        kv_start.astype(jnp.int32),
+        kv_len.astype(jnp.int32),
+        qh,
+        k_cache,
+        v_cache,
+        k_scale,
+        v_scale,
+    )
+
+    return out.reshape(B, 1, H, hd)
+
+
+def decode_attention_xla_q8(
+    q: jax.Array,  # [B, 1, H, hd]
+    k_cache: jax.Array,  # [L, B, K, T, hd] int8
+    v_cache: jax.Array,  # [L, B, K, T, hd] int8
+    k_scale: jax.Array,  # [L, B, K, T] fp32
+    v_scale: jax.Array,  # [L, B, K, T] fp32
+    kv_start: jax.Array,  # [B]
+    kv_len: jax.Array,  # [B]
+    layer: jax.Array,  # [] or [1] int32
+) -> jax.Array:
+    """Dense XLA reference for ``decode_attention_q8`` (oracle; CPU path).
+    Dequantizes THIS layer's cache slice and reuses the bf16 oracle."""
+    kd = dequantize_layer_slice(k_cache, k_scale, layer, kv_start, kv_len, q.dtype)
+    vd = dequantize_layer_slice(v_cache, v_scale, layer, kv_start, kv_len, q.dtype)
+    return decode_attention_xla(q, kd, vd, kv_start, kv_len, jnp.int32(0))

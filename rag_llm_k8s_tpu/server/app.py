@@ -309,6 +309,26 @@ class RagService:
         serving_engine.warmup(
             batch_sizes=(1,), buckets=serving_engine.engine_config.prompt_buckets
         )
+        from rag_llm_k8s_tpu.engine.batching import BatchScheduler
+
+        if isinstance(self.scheduler, BatchScheduler):
+            # the coalescing scheduler pads grouped requests to the next
+            # power of two: warm that ladder at the largest bucket (where
+            # every full-context RAG prompt lands) or the first concurrent
+            # burst pays a per-shape compile mid-request
+            ec = serving_engine.engine_config
+            # the ladder tops out at the engine's PADDED shape for a full
+            # batch (next_pow2(max_batch_size)), not max_batch_size itself —
+            # a cap of 6 pads 5-6-request bursts to batch 8
+            top = serving_engine._bucket_batch(ec.max_batch_size)
+            sizes, b = [], 2
+            while b <= top:
+                sizes.append(b)
+                b *= 2
+            if sizes:
+                serving_engine.warmup(
+                    batch_sizes=tuple(sizes), buckets=(max(ec.prompt_buckets),)
+                )
         if serving_engine is not self.engine:
             # over-bucket prompts bypass the scheduler into the one-shot
             # engine's chunked prefill — warm one representative overflow

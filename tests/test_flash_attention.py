@@ -104,6 +104,125 @@ class TestDecodeAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
 
 
+class TestDecodeAttentionQ8:
+    """int8-KV decode kernel (interpret mode) vs its oracle and vs bf16."""
+
+    def _problem(self, seed, B=2, H=8, K=2, T=256, hd=64, L=3):
+        from rag_llm_k8s_tpu.ops.attention import quantize_kv
+
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (B, 1, H, hd), jnp.float32)
+        k_cache = jax.random.normal(ks[1], (L, B, K, T, hd), jnp.float32)
+        v_cache = jax.random.normal(ks[2], (L, B, K, T, hd), jnp.float32)
+        kq, kscale = quantize_kv(k_cache)
+        vq, vscale = quantize_kv(v_cache)
+        return q, k_cache, v_cache, kq, kscale, vq, vscale
+
+    def test_quantize_kv_roundtrip(self):
+        from rag_llm_k8s_tpu.ops.attention import quantize_kv
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 64), jnp.float32)
+        q, s = quantize_kv(x)
+        assert q.dtype == jnp.int8 and s.shape == (4, 8)
+        deq = q.astype(jnp.float32) * s[..., None]
+        # per-element error bounded by half a quantization step
+        assert float(jnp.max(jnp.abs(deq - x) - s[..., None] / 2)) <= 1e-6
+
+    def test_kernel_matches_q8_oracle_per_layer(self):
+        """The int8 kernel and the int8 XLA oracle see the SAME quantized
+        payload, so they must agree to kernel-numerics tolerance."""
+        from rag_llm_k8s_tpu.ops.attention import (
+            decode_attention_q8,
+            decode_attention_xla_q8,
+        )
+
+        q, _, _, kq, kscale, vq, vscale = self._problem(0)
+        T = kq.shape[3]
+        kv_start = jnp.array([0, 37], jnp.int32)
+        kv_len = jnp.array([T, 150], jnp.int32)
+        for lay in range(kq.shape[0]):
+            got = decode_attention_q8(
+                q, kq, vq, kscale, vscale, kv_start, kv_len, jnp.int32(lay),
+                bk=64, interpret=True,
+            )
+            want = decode_attention_xla_q8(
+                q, kq, vq, kscale, vscale, kv_start, kv_len, jnp.int32(lay)
+            )
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+            )
+
+    def test_q8_close_to_bf16_attention(self):
+        """End result stays close to the unquantized cache path: int8 KV is
+        a ~0.4%-per-element perturbation, and softmax-weighted averaging
+        keeps the output error at the same order."""
+        from rag_llm_k8s_tpu.ops.attention import (
+            decode_attention_q8,
+            decode_attention_xla,
+        )
+
+        q, kc, vc, kq, kscale, vq, vscale = self._problem(1)
+        T = kc.shape[3]
+        kv_start = jnp.array([3, 0], jnp.int32)
+        kv_len = jnp.array([T - 5, T], jnp.int32)
+        lay = jnp.int32(1)
+        got = decode_attention_q8(
+            q, kq, vq, kscale, vscale, kv_start, kv_len, lay, bk=64, interpret=True
+        )
+        want = decode_attention_xla(q, kc, vc, kv_start, kv_len, lay)
+        err = float(
+            jnp.linalg.norm(got - want) / (jnp.linalg.norm(want) + 1e-9)
+        )
+        assert err < 0.02, f"relative error vs bf16 cache: {err}"
+
+    def test_single_valid_slot(self):
+        from rag_llm_k8s_tpu.ops.attention import (
+            decode_attention_q8,
+            decode_attention_xla_q8,
+        )
+
+        q, _, _, kq, kscale, vq, vscale = self._problem(2)
+        kv_start = jnp.array([5, 200], jnp.int32)
+        kv_len = kv_start + 1
+        lay = jnp.int32(2)
+        got = decode_attention_q8(
+            q, kq, vq, kscale, vscale, kv_start, kv_len, lay, bk=64, interpret=True
+        )
+        want = decode_attention_xla_q8(
+            q, kq, vq, kscale, vscale, kv_start, kv_len, lay
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+    def test_uninitialized_scale_slots_do_not_poison(self):
+        """Slots past the frontier carry NaN scales (as donated device
+        memory can); the masked dequant must still produce finite output."""
+        from rag_llm_k8s_tpu.ops.attention import (
+            decode_attention_q8,
+            decode_attention_xla_q8,
+        )
+
+        q, _, _, kq, kscale, vq, vscale = self._problem(3)
+        T = kq.shape[3]
+        valid = jnp.arange(T)[None, None, None, :] < 100
+        kscale = jnp.where(valid, kscale, jnp.nan)
+        vscale = jnp.where(valid, vscale, jnp.nan)
+        kv_start = jnp.array([0, 10], jnp.int32)
+        kv_len = jnp.array([100, 100], jnp.int32)
+        lay = jnp.int32(0)
+        got = decode_attention_q8(
+            q, kq, vq, kscale, vscale, kv_start, kv_len, lay, bk=64, interpret=True
+        )
+        assert bool(jnp.all(jnp.isfinite(got)))
+        want = decode_attention_xla_q8(
+            q, kq, vq, kscale, vscale, kv_start, kv_len, lay
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+
 class TestModelPallasPath:
     """Full LlamaModel with Pallas attention (interpret) vs the XLA oracle
     model — proves the kernels are THE serving path, not an island."""

@@ -227,6 +227,15 @@ class TestEnvWiring:
         with pytest.raises(ValueError, match="TPU_RAG_WEIGHT_QUANT"):
             AppConfig.from_env({"TPU_RAG_WEIGHT_QUANT": "fp8"})
 
+    def test_kv_quant_env_override(self):
+        from rag_llm_k8s_tpu.core.config import AppConfig
+
+        cfg = AppConfig.from_env({"TPU_RAG_KV_QUANT": "int8"})
+        assert cfg.engine.kv_quant == "int8"
+        assert AppConfig.from_env({}).engine.kv_quant == "bf16"
+        with pytest.raises(ValueError, match="TPU_RAG_KV_QUANT"):
+            AppConfig.from_env({"TPU_RAG_KV_QUANT": "fp4"})
+
 
 class TestLoaderInt8:
     def test_streaming_layout_and_dtypes(self):
@@ -285,6 +294,154 @@ class TestLoaderInt8:
                     np.asarray(a[path], np.int32) - np.asarray(b[path], np.int32)
                 )
                 assert diff.max() <= 1, path
+
+
+class TestKVQuant:
+    """int8 KV cache (EngineConfig.kv_quant) through the one-shot engine."""
+
+    def test_greedy_matches_bf16_cache(self):
+        cfg = tiny(False)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        prompts = [[cfg.bos_token_id, 5, 7, 9], [cfg.bos_token_id, 3]]
+        outs = {}
+        for kvq in ("bf16", "int8"):
+            eng = InferenceEngine(
+                cfg, params,
+                sampling=SamplingConfig(do_sample=False, max_new_tokens=8),
+                engine_config=EngineConfig(
+                    prompt_buckets=(16,), max_batch_size=2, kv_quant=kvq
+                ),
+                dtypes=DT,
+            )
+            outs[kvq] = eng.generate(prompts)
+        assert outs["bf16"] == outs["int8"]
+
+    def test_composes_with_weight_quant(self):
+        """Both quantizations together — the full int8 serving mode."""
+        cfg = tiny(False)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        eng = InferenceEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=8),
+            engine_config=EngineConfig(
+                prompt_buckets=(16,), max_batch_size=1,
+                weight_quant="int8", kv_quant="int8",
+            ),
+            dtypes=DT,
+        )
+        out = eng.generate([[cfg.bos_token_id, 11, 3]])
+        assert len(out[0]) == 8
+
+    def test_chunked_prefill_with_int8_cache(self):
+        """Long prompts prefill through the quantized cache chunk by chunk
+        (layer-slice dequant + bf16 chunk kernel) and keep decoding."""
+        cfg = tiny(False)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+
+        def build(kvq):
+            return InferenceEngine(
+                cfg, params,
+                sampling=SamplingConfig(do_sample=False, max_new_tokens=4),
+                engine_config=EngineConfig(
+                    prompt_buckets=(16,), max_batch_size=1, max_seq_len=64,
+                    max_chunked_prompt=64, kv_quant=kvq,
+                ),
+                dtypes=DT,
+            )
+
+        long_prompt = [cfg.bos_token_id] + list(range(3, 40))
+        want = build("bf16").generate([long_prompt])
+        got = build("int8").generate([long_prompt])
+        assert want == got
+
+    def test_cache_arrays_are_int8(self):
+        from rag_llm_k8s_tpu.models.llama import make_kv_cache
+
+        cache = make_kv_cache(LlamaConfig.tiny(), 2, 32, quant="int8")
+        assert cache.k.dtype == jnp.int8 and cache.v.dtype == jnp.int8
+        assert cache.k_scale.dtype == jnp.float32
+        assert cache.k_scale.shape == cache.k.shape[:-1]
+        bf16 = make_kv_cache(LlamaConfig.tiny(), 2, 32)
+        assert bf16.k_scale is None
+
+    def test_row_frontier_int8_write_matches_bf16(self):
+        """The per-row scatter write path (continuous batching's layout)
+        quantizes correctly: prefill then one row-frontier decode step at
+        DIFFERENT per-row frontiers matches the bf16-cache model closely,
+        and the scale planes carry the written slots. (The continuous
+        engine itself still rejects int8 KV; this pins the model-level
+        support it will adopt.)"""
+        from rag_llm_k8s_tpu.models.llama import LlamaModel, make_kv_cache
+
+        cfg = tiny(False)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        B, S, T = 2, 4, 32
+        tokens = jnp.array([[7, 5, 3, 2], [9, 4, 6, 8]], jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(S), (B, S))
+        logits = {}
+        for kvq in ("bf16", "int8"):
+            model = LlamaModel(cfg, DT, attn_impl="xla", kv_quant=kvq)
+            step = LlamaModel(
+                cfg, DT, attn_impl="xla", kv_quant=kvq, row_frontier=True
+            )
+            cache = make_kv_cache(cfg, B, T, DT.compute_dtype, quant=kvq)
+            _, cache = model.apply(
+                {"params": params}, tokens, pos, cache,
+                jnp.zeros((B,), jnp.int32), jnp.full((B,), S, jnp.int32),
+                jnp.int32(0),
+            )
+            wi = jnp.array([4, 2], jnp.int32)  # per-row frontiers differ
+            lg, cache = step.apply(
+                {"params": params},
+                jnp.array([[11], [13]], jnp.int32),
+                wi[:, None],
+                cache,
+                jnp.zeros((B,), jnp.int32),
+                wi + 1,
+                wi,
+            )
+            logits[kvq] = lg
+            if kvq == "int8":
+                assert cache.k.dtype == jnp.int8
+                # each row's scale slot at ITS OWN frontier was written
+                assert float(cache.k_scale[0, 0, 0, 4]) > 0
+                assert float(cache.k_scale[0, 1, 0, 2]) > 0
+        rel = float(
+            jnp.linalg.norm(logits["int8"] - logits["bf16"])
+            / (jnp.linalg.norm(logits["bf16"]) + 1e-9)
+        )
+        assert rel < 0.05, rel
+
+    def test_continuous_engine_rejects_int8_kv(self):
+        cfg = tiny(False)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        with pytest.raises(NotImplementedError, match="one-shot-engine only"):
+            ContinuousEngine(
+                cfg, params,
+                engine_config=EngineConfig(
+                    prompt_buckets=(16,), max_batch_size=2, max_seq_len=64,
+                    kv_quant="int8",
+                ),
+                dtypes=DT,
+            )
+
+    def test_tp_generate_matches_single_device_int8_kv(self):
+        cfg = tiny(False)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        prompts = [[cfg.bos_token_id, 5, 7]] * 2
+        mk = lambda mesh_ctx, p: InferenceEngine(  # noqa: E731
+            cfg, p,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=6),
+            engine_config=EngineConfig(
+                prompt_buckets=(16,), max_batch_size=2, kv_quant="int8"
+            ),
+            dtypes=DT,
+            mesh=mesh_ctx,
+        )
+        ref = mk(None, params).generate(prompts)
+        ctx = make_mesh(MeshConfig(dp=2, sp=1, tp=4))
+        got = mk(ctx, shard_llama_params(params, ctx)).generate(prompts)
+        assert ref == got
 
 
 class TestQuantTP:
