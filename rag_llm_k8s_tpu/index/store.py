@@ -41,6 +41,115 @@ from rag_llm_k8s_tpu.utils.buckets import next_pow2
 _FORMAT_VERSION = 1
 
 
+def _indexio():
+    """The C++ snapshot codec (native/indexio.cpp): CRC32-verified payload,
+    fsync-before-rename durability. None ⇒ numpy .npy fallback (no checksum
+    — the codec exists because faiss's writer and np.save both lack one)."""
+    try:
+        from rag_llm_k8s_tpu.native import load_library
+    except ImportError:
+        return None
+    import ctypes
+
+    lib = load_library("indexio")
+    if lib is None:
+        return None
+    lib.indexio_write.restype = ctypes.c_int32
+    lib.indexio_write.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.indexio_read_header.restype = ctypes.c_int32
+    lib.indexio_read_header.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64)
+    ]
+    lib.indexio_read.restype = ctypes.c_int32
+    lib.indexio_read.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64
+    ]
+    return lib
+
+
+def _save_vectors(vec_path: str, vectors: np.ndarray, generation: int) -> str:
+    """Persist the fp32 payload. Native codec when available (checksummed,
+    fsynced, atomic); tmp-then-rename .npy otherwise. Returns the format
+    actually written ("indexio" | "npy") for the metadata record."""
+    import ctypes
+
+    lib = _indexio()
+    vectors = np.ascontiguousarray(vectors, np.float32)
+    if lib is not None:
+        rc = lib.indexio_write(
+            vec_path.encode(), vectors.shape[1] if vectors.ndim == 2 else 0,
+            vectors.shape[0], generation,
+            vectors.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        if rc == 0:
+            return "indexio"
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "native index write failed (rc=%d); falling back to npy", rc
+        )
+    dir_ = os.path.dirname(vec_path) or "."
+    fd, tmp = tempfile.mkstemp(dir=dir_, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.save(f, vectors)
+        os.replace(tmp, vec_path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return "npy"
+
+
+def _load_vectors(vec_path: str, dim: int) -> np.ndarray:
+    """Load the payload, auto-detecting format: the native codec's magic
+    first (CRC-verified — corruption raises instead of silently mis-ranking
+    every future search), .npy otherwise (including pre-codec snapshots)."""
+    import ctypes
+
+    with open(vec_path, "rb") as f:
+        magic = f.read(8)
+    if magic == b"TPURIDX1":
+        lib = _indexio()
+        if lib is None:
+            raise RuntimeError(
+                f"{vec_path} is a native-codec snapshot but no C++ toolchain "
+                "is available to read it"
+            )
+        hdr = (ctypes.c_int64 * 4)()
+        rc = lib.indexio_read_header(vec_path.encode(), hdr)
+        if rc != 0:
+            raise ValueError(f"index payload header corrupt ({vec_path}, rc={rc})")
+        f_dim, count, _gen, payload = hdr[0], hdr[1], hdr[2], hdr[3]
+        if f_dim != dim:
+            raise ValueError(f"index payload dim {f_dim} != expected {dim}")
+        # the CRC covers the payload, not the header: a corrupted header
+        # must fail HERE, not size the read buffer (count/payload mismatch
+        # would otherwise hand indexio_read a larger byte count than the
+        # numpy allocation — heap overflow, not a clean error)
+        if count < 0 or payload != count * dim * 4:
+            raise ValueError(
+                f"index payload header inconsistent ({vec_path}: count={count}, "
+                f"dim={dim}, payload_bytes={payload}) — snapshot is corrupt"
+            )
+        out = np.empty((count, dim), np.float32)
+        rc = lib.indexio_read(
+            vec_path.encode(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            payload,
+        )
+        if rc != 0:
+            raise ValueError(
+                f"index payload failed CRC/read ({vec_path}, rc={rc}) — "
+                "snapshot is corrupt"
+            )
+        return out
+    return np.load(vec_path)
+
+
 @jax.jit
 def _dev_append(emb, norms, rows, n_old, n_real):
     """Produce a NEW snapshot with ``rows[:n_real]`` written at ``n_old``.
@@ -242,19 +351,13 @@ class VectorStore:
             }
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             dir_ = os.path.dirname(path) or "."
-            # vectors (npy) and metadata (json), each written tmp-then-rename;
-            # metadata lands LAST and names the vector payload it belongs to,
-            # so a crash between the two renames leaves a consistent pair
+            # vectors (native codec or npy) and metadata (json), each written
+            # tmp-then-rename; metadata lands LAST and names the payload it
+            # belongs to, so a crash between the renames leaves a usable pair
             vec_path = path + ".vectors.npy"
-            fd, tmp = tempfile.mkstemp(dir=dir_, suffix=".tmp")
-            try:
-                with os.fdopen(fd, "wb") as f:
-                    np.save(f, self._vectors)
-                os.replace(tmp, vec_path)
-            except BaseException:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
-                raise
+            payload_meta["vector_format"] = _save_vectors(
+                vec_path, self._vectors, self.generation
+            )
             fd, tmp = tempfile.mkstemp(dir=dir_, suffix=".tmp")
             try:
                 with os.fdopen(fd, "w") as f:
@@ -273,7 +376,7 @@ class VectorStore:
         if meta.get("format_version") != _FORMAT_VERSION:
             raise ValueError(f"unsupported index format: {meta.get('format_version')}")
         store = cls(dim=meta["dim"], path=path)
-        vectors = np.load(path + ".vectors.npy")
+        vectors = _load_vectors(path + ".vectors.npy", meta["dim"])
         count = meta["count"]
         if vectors.shape[0] < count:
             raise ValueError(

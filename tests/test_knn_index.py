@@ -124,6 +124,77 @@ class TestVectorStore:
         with pytest.raises(ValueError, match="corrupt"):
             VectorStore.load(p)
 
+    def test_native_codec_writes_checksummed_payload(self, tmp_path):
+        """The C++ snapshot codec (native/indexio.cpp) is the payload
+        writer when the toolchain is present: magic header + CRC."""
+        from rag_llm_k8s_tpu.index.store import _indexio
+
+        if _indexio() is None:
+            pytest.skip("no C++ toolchain")
+        p = str(tmp_path / "idx")
+        store, vecs, _ = self._mk(path=p)
+        store.save()
+        with open(p + ".vectors.npy", "rb") as f:
+            assert f.read(8) == b"TPURIDX1"
+        with open(p) as f:
+            assert json.load(f)["vector_format"] == "indexio"
+        loaded = VectorStore.load(p)
+        np.testing.assert_array_equal(loaded._vectors, store._vectors)
+
+    def test_payload_corruption_detected_by_crc(self, tmp_path):
+        """A flipped payload byte fails the CRC on load — faiss's writer and
+        np.save would both return silently corrupted vectors here."""
+        from rag_llm_k8s_tpu.index.store import _indexio
+
+        if _indexio() is None:
+            pytest.skip("no C++ toolchain")
+        p = str(tmp_path / "idx")
+        store, _, _ = self._mk(path=p)
+        store.save()
+        vec_path = p + ".vectors.npy"
+        data = bytearray(open(vec_path, "rb").read())
+        data[60] ^= 0xFF  # one payload byte (header is 48 bytes)
+        open(vec_path, "wb").write(bytes(data))
+        with pytest.raises(ValueError, match="CRC|corrupt"):
+            VectorStore.load(p)
+
+    def test_header_corruption_rejected_before_allocation(self, tmp_path):
+        """The CRC covers the payload only — a corrupted header (count vs
+        payload_bytes mismatch) must raise cleanly, never size the read
+        buffer (heap-overflow vector)."""
+        import struct
+
+        from rag_llm_k8s_tpu.index.store import _indexio
+
+        if _indexio() is None:
+            pytest.skip("no C++ toolchain")
+        p = str(tmp_path / "idx")
+        store, _, _ = self._mk(path=p)
+        store.save()
+        vec_path = p + ".vectors.npy"
+        data = bytearray(open(vec_path, "rb").read())
+        data[16:24] = struct.pack("<q", 1 << 40)  # count field
+        open(vec_path, "wb").write(bytes(data))
+        with pytest.raises(ValueError, match="inconsistent|corrupt"):
+            VectorStore.load(p)
+
+    def test_npy_snapshots_still_load(self, tmp_path):
+        """Back-compat: pre-codec snapshots (plain .npy payload) load."""
+        p = str(tmp_path / "idx")
+        store, vecs, _ = self._mk(path=p)
+        store.save()
+        # overwrite the payload with the legacy npy format
+        np.save(open(p + ".vectors.npy", "wb"), store._vectors)
+        loaded = VectorStore.load(p)
+        assert loaded.ntotal == store.ntotal
+        np.testing.assert_array_equal(loaded._vectors, store._vectors)
+
+    def test_empty_store_roundtrips_through_codec(self, tmp_path):
+        p = str(tmp_path / "idx")
+        s = VectorStore(dim=8, path=p)
+        s.save()
+        assert VectorStore.load(p).ntotal == 0
+
     def test_info_shape(self):
         store, _, _ = self._mk()
         info = store.info()
