@@ -296,10 +296,10 @@ class EngineConfig:
     weight_quant: str = "bf16"
     # KV-cache storage: "bf16" (exact) or "int8" (one fp32 scale per
     # (token, kv-head) vector — halves the cache bytes every decode step
-    # scans AND the cache HBM footprint; at the full 4352-token budget the
+    # scans AND the cache HBM footprint; with a 4096-token prompt bucket the
     # cache is ~1/3 of step bandwidth. ops.attention.decode_attention_q8 is
-    # the kernel; parity bounds in tests. One-shot engine only — the
-    # continuous engine's row-insert path stays bf16.)
+    # the kernel; parity bounds in tests. Both engines support it — the
+    # continuous engine threads the scale planes through its slot state.)
     kv_quant: str = "bf16"
 
 

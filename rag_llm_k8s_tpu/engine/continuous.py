@@ -107,25 +107,24 @@ class ContinuousEngine:
                 f"max_seq_len={engine_config.max_seq_len} (slot length {self.T})"
             )
         jmesh = mesh.mesh if mesh is not None and mesh.tp > 1 else None
-        if engine_config.kv_quant != "bf16":
-            # the row-insert executables donate and rebuild per-row cache
-            # slices; extending them to the (payload, scale) pair is tracked
-            # work — serve int8-KV through InferenceEngine meanwhile
-            raise NotImplementedError(
-                "kv_quant='int8' is one-shot-engine only; the continuous "
-                "engine's KV cache stays bf16"
+        if engine_config.kv_quant not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_quant={engine_config.kv_quant!r}: expected 'bf16' or 'int8'"
             )
+        self.kv_quant = engine_config.kv_quant
         self.params, fused = maybe_fuse_params(params, engine_config, mesh)
         self.params, quantized = maybe_quantize_params(self.params, engine_config)
         self.model = LlamaModel(
             config, dtypes, attn_impl=engine_config.attn_impl, mesh=jmesh,
-            fused_qkv=fused, quantized=quantized,
+            fused_qkv=fused, quantized=quantized, kv_quant=self.kv_quant,
         )
         self.model_step = self.model.copy(row_frontier=True)
         self._compiled: Dict[Tuple[str, int], jax.stages.Compiled] = {}
         # ---- persistent device state -----------------------------------
-        cache = make_kv_cache(config, self.B, self.T, dtypes.compute_dtype)
-        self._cache_k, self._cache_v = cache.k, cache.v
+        # the cache rides as a TUPLE pytree through every executable:
+        # (k, v) bf16, or (k, v, k_scale, v_scale) with kv_quant="int8" —
+        # the int8 payloads and fp32 scale planes donate/rebuild together
+        self._cache = self._fresh_cache()
         self._kv_start = jnp.zeros((self.B,), jnp.int32)
         self._kv_len = jnp.zeros((self.B,), jnp.int32)
         self._last_tok = jnp.zeros((self.B,), jnp.int32)
@@ -151,6 +150,16 @@ class ContinuousEngine:
             self._get("insert", S)
         self._get("step", 0)
 
+    def _fresh_cache(self):
+        """The cache-state tuple for the full [B, T] slot block (__init__)."""
+        cache = make_kv_cache(
+            self.config, self.B, self.T, self.dtypes.compute_dtype,
+            quant=self.kv_quant,
+        )
+        if self.kv_quant == "int8":
+            return (cache.k, cache.v, cache.k_scale, cache.v_scale)
+        return (cache.k, cache.v)
+
     def reset(self):
         """Rebuild ALL device state after a failed step. A step that dies
         during device execution has already invalidated its DONATED inputs
@@ -158,8 +167,7 @@ class ContinuousEngine:
         leave the next admit holding deleted arrays, bricking the engine
         while /healthz still reports ready."""
         self.slots = [_Slot() for _ in range(self.B)]
-        cache = make_kv_cache(self.config, self.B, self.T, self.dtypes.compute_dtype)
-        self._cache_k, self._cache_v = cache.k, cache.v
+        self._cache = self._fresh_cache()
         self._kv_start = jnp.zeros((self.B,), jnp.int32)
         self._kv_len = jnp.zeros((self.B,), jnp.int32)
         self._last_tok = jnp.zeros((self.B,), jnp.int32)
@@ -179,13 +187,24 @@ class ContinuousEngine:
             self._compiled[key] = fn
         return fn
 
+    def _cache_avals(self, batch: int, length: int):
+        """ShapeDtypeStructs matching the cache-state tuple."""
+        L, K, hd = self.config.num_layers, self.config.num_kv_heads, self.config.head_dim
+        cdt = jnp.int8 if self.kv_quant == "int8" else self.dtypes.compute_dtype
+        payload = jax.ShapeDtypeStruct((L, batch, K, length, hd), cdt)
+        if self.kv_quant == "int8":
+            scale = jax.ShapeDtypeStruct((L, batch, K, length), jnp.float32)
+            return (payload, payload, scale, scale)
+        return (payload, payload)
+
     def _build_prefill(self, S: int):
         cfg, dt, sampling = self.config, self.dtypes, self.sampling
         model = self.model
+        kv_quant = self.kv_quant
 
         def prefill(params, tokens, pad_mask, rng):
             # B=1 single-shot prefill into a fresh S-length row cache
-            cache = make_kv_cache(cfg, 1, S, dt.compute_dtype)
+            cache = make_kv_cache(cfg, 1, S, dt.compute_dtype, quant=kv_quant)
             kv_start, _ = mask_window(pad_mask)
             positions = jnp.clip(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
             logits, cache = model.apply(
@@ -194,7 +213,11 @@ class ContinuousEngine:
                 last_logit_only=True,
             )
             tok0 = sample_token(rng, logits[:, -1], sampling)[0]
-            return cache.k, cache.v, tok0, kv_start[0]
+            row = (
+                (cache.k, cache.v, cache.k_scale, cache.v_scale)
+                if kv_quant == "int8" else (cache.k, cache.v)
+            )
+            return row, tok0, kv_start[0]
 
         return jax.jit(prefill).lower(
             param_avals(self.params),
@@ -204,31 +227,30 @@ class ContinuousEngine:
         ).compile()
 
     def _build_insert(self, S: int):
-        T = self.T
-
-        def insert(ck, cv, row_k, row_v, kv_start, kv_len, last_tok, active,
+        def insert(cache, row_cache, kv_start, kv_len, last_tok, active,
                    rng_keys, row, row_start, tok0, row_key):
             # the row's prompt KV occupies slots [0, S); frontiers are per-row
-            # so nothing else moves
-            ck = jax.lax.dynamic_update_slice(ck, row_k, (0, row, 0, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, row_v, (0, row, 0, 0, 0))
+            # so nothing else moves. zip pairs each state plane (payload or
+            # scale) with its [L, 1, ...] row block — same update either way
+            cache = tuple(
+                jax.lax.dynamic_update_slice(
+                    c, r, (0, row) + (0,) * (c.ndim - 2)
+                )
+                for c, r in zip(cache, row_cache)
+            )
             kv_start = kv_start.at[row].set(row_start)
             kv_len = kv_len.at[row].set(S)
             last_tok = last_tok.at[row].set(tok0)
             active = active.at[row].set(True)
             rng_keys = rng_keys.at[row].set(row_key)
-            return ck, cv, kv_start, kv_len, last_tok, active, rng_keys
+            return cache, kv_start, kv_len, last_tok, active, rng_keys
 
-        L, K, hd = self.config.num_layers, self.config.num_kv_heads, self.config.head_dim
-        cdt = self.dtypes.compute_dtype
         i32 = jnp.int32
-        # row_k/row_v are not donated: a [L,1,K,S,hd] block cannot alias into
-        # the [L,B,K,T,hd] cache, so donation would only emit a warning
-        return jax.jit(insert, donate_argnums=(0, 1, 4, 5, 8)).lower(
-            jax.ShapeDtypeStruct((L, self.B, K, T, hd), cdt),
-            jax.ShapeDtypeStruct((L, self.B, K, T, hd), cdt),
-            jax.ShapeDtypeStruct((L, 1, K, S, hd), cdt),
-            jax.ShapeDtypeStruct((L, 1, K, S, hd), cdt),
+        # row_cache is not donated: a [L,1,...] block cannot alias into the
+        # [L,B,...] cache, so donation would only emit a warning
+        return jax.jit(insert, donate_argnums=(0, 2, 3, 6)).lower(
+            self._cache_avals(self.B, self.T),
+            self._cache_avals(1, S),
             jax.ShapeDtypeStruct((self.B,), i32),
             jax.ShapeDtypeStruct((self.B,), i32),
             jax.ShapeDtypeStruct((self.B,), i32),
@@ -245,15 +267,16 @@ class ContinuousEngine:
         model = self.model_step
         eos_ids = cfg.eos_token_ids
         B, T = self.B, self.T
+        kv_quant = self.kv_quant
 
-        def step(params, ck, cv, kv_start, kv_len, last_tok, active, rng_keys):
+        def step(params, cache_t, kv_start, kv_len, last_tok, active, rng_keys):
             wi = jnp.where(active, kv_len, 0)  # inactive rows park at slot 0
             posv = jnp.clip(wi - kv_start, 0)  # inactive rows: junk, masked
             from rag_llm_k8s_tpu.models.llama import KVCache
 
             logits, cache = model.apply(
                 {"params": params}, last_tok[:, None], posv[:, None],
-                KVCache(k=ck, v=cv), kv_start, wi + 1, wi,
+                KVCache(*cache_t), kv_start, wi + 1, wi,
             )
             # key = fold(row seed key, token position): draws depend only on
             # the request's own seed + position, never on batchmates — a
@@ -265,17 +288,18 @@ class ContinuousEngine:
             # stays < T (the scheduler retires rows before they get close)
             kv_len = jnp.where(active, jnp.minimum(wi + 1, T - 1), kv_len)
             active = active & ~hit_eos
-            return cache.k, cache.v, kv_len, tok, hit_eos, active
+            out = (
+                (cache.k, cache.v, cache.k_scale, cache.v_scale)
+                if kv_quant == "int8" else (cache.k, cache.v)
+            )
+            return out, kv_len, tok, hit_eos, active
 
         i32 = jnp.int32
-        cdt = dt.compute_dtype
-        L, K, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
-        # kv_start (3) and rng_keys (7) are NOT donated: neither is among the
+        # kv_start (2) and rng_keys (6) are NOT donated: neither is among the
         # outputs, and the host keeps using their buffers across steps
-        return jax.jit(step, donate_argnums=(1, 2, 4, 5, 6)).lower(
+        return jax.jit(step, donate_argnums=(1, 3, 4, 5)).lower(
             param_avals(self.params),
-            jax.ShapeDtypeStruct((L, B, K, T, hd), cdt),
-            jax.ShapeDtypeStruct((L, B, K, T, hd), cdt),
+            self._cache_avals(B, T),
             jax.ShapeDtypeStruct((B,), i32),
             jax.ShapeDtypeStruct((B,), i32),
             jax.ShapeDtypeStruct((B,), i32),
@@ -332,7 +356,7 @@ class ContinuousEngine:
             self._rng, row_key = jax.random.split(self._rng)
         # position-indexed draw: the first sampled token sits at position
         # len(p); decode steps continue the same fold sequence
-        row_k, row_v, tok0, row_start = self._get("prefill", S)(
+        row_cache, tok0, row_start = self._get("prefill", S)(
             self.params, jnp.asarray(tokens), jnp.asarray(mask),
             jax.random.fold_in(row_key, len(p)),
         )
@@ -345,9 +369,9 @@ class ContinuousEngine:
             return row, out
 
         try:
-            (self._cache_k, self._cache_v, self._kv_start, self._kv_len,
+            (self._cache, self._kv_start, self._kv_len,
              self._last_tok, self._active, self._rng_keys) = self._get("insert", S)(
-                self._cache_k, self._cache_v, row_k, row_v,
+                self._cache, row_cache,
                 self._kv_start, self._kv_len, self._last_tok, self._active,
                 self._rng_keys, jnp.int32(row), row_start, jnp.int32(tok0),
                 row_key,
@@ -369,9 +393,9 @@ class ContinuousEngine:
     def step(self) -> List[Tuple[int, List[int]]]:
         """One decode step for every active slot. Returns completed requests
         as ``(request_id, tokens)`` and frees their slots."""
-        (self._cache_k, self._cache_v, self._kv_len, tok, hit_eos,
+        (self._cache, self._kv_len, tok, hit_eos,
          self._active) = self._get("step", 0)(
-            self.params, self._cache_k, self._cache_v, self._kv_start,
+            self.params, self._cache, self._kv_start,
             self._kv_len, self._last_tok, self._active, self._rng_keys,
         )
         self._last_tok = tok

@@ -362,11 +362,20 @@ class VectorStore:
             try:
                 with os.fdopen(fd, "w") as f:
                     json.dump(payload_meta, f)
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, path)
             except BaseException:
                 if os.path.exists(tmp):
                     os.unlink(tmp)
                 raise
+            # make the rename itself durable (the codec fsyncs its parent
+            # dir for the payload; the metadata rename needs the same)
+            dfd = os.open(dir_, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
         return path
 
     @classmethod

@@ -34,24 +34,55 @@ namespace {
 constexpr char kMagic[8] = {'T', 'P', 'U', 'R', 'I', 'D', 'X', '1'};
 constexpr int64_t kHeaderBytes = 48;
 
-uint32_t crc32_table[256];
-bool crc32_ready = false;
-
-void crc32_init() {
-  if (crc32_ready) return;
-  for (uint32_t i = 0; i < 256; i++) {
-    uint32_t c = i;
-    for (int j = 0; j < 8; j++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-    crc32_table[i] = c;
+struct Crc32Tables {
+  // slicing-by-8: 8 derived tables -> one table lookup per byte becomes
+  // 8 bytes per loop iteration (~5-8x faster; a multi-GB payload would
+  // otherwise spend seconds under the store's writer lock per save)
+  uint32_t t[8][256];
+  Crc32Tables() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int j = 0; j < 8; j++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[0][i] = c;
+    }
+    for (int k = 1; k < 8; k++)
+      for (uint32_t i = 0; i < 256; i++)
+        t[k][i] = t[0][t[k - 1][i] & 0xFF] ^ (t[k - 1][i] >> 8);
   }
-  crc32_ready = true;
-}
+};
 
 uint32_t crc32(const uint8_t* data, int64_t n) {
-  crc32_init();
+  // C++11 magic static: thread-safe one-time construction (a racy manual
+  // ready-flag could let a second thread read a half-built table and stamp
+  // a wrong CRC into a perfectly good snapshot)
+  static const Crc32Tables tables;
+  const auto& t = tables.t;
   uint32_t c = 0xFFFFFFFFu;
-  for (int64_t i = 0; i < n; i++) c = crc32_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  int64_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, data + i, 4);
+    std::memcpy(&hi, data + i + 4, 4);
+    lo ^= c;
+    c = t[7][lo & 0xFF] ^ t[6][(lo >> 8) & 0xFF] ^ t[5][(lo >> 16) & 0xFF] ^
+        t[4][lo >> 24] ^ t[3][hi & 0xFF] ^ t[2][(hi >> 8) & 0xFF] ^
+        t[1][(hi >> 16) & 0xFF] ^ t[0][hi >> 24];
+  }
+  for (; i < n; i++) c = t[0][(c ^ data[i]) & 0xFF] ^ (c >> 8);
   return c ^ 0xFFFFFFFFu;
+}
+
+// fsync the parent directory so a rename is itself durable — without it a
+// power cut after save() can resurrect the OLD payload next to NEW metadata
+int fsync_parent(const char* path) {
+  std::string dir(path);
+  const size_t slash = dir.find_last_of('/');
+  dir = (slash == std::string::npos) ? "." : dir.substr(0, slash);
+  const int dfd = ::open(dir.c_str(), O_RDONLY);
+  if (dfd < 0) return -1;
+  const int rc = ::fsync(dfd);
+  ::close(dfd);
+  return rc;
 }
 
 struct Header {
@@ -106,6 +137,7 @@ int32_t indexio_write(const char* path, int64_t dim, int64_t count,
   ok = (::close(fd) == 0) && ok;
   if (!ok) { ::unlink(tmp.c_str()); return -2; }
   if (::rename(tmp.c_str(), path) != 0) { ::unlink(tmp.c_str()); return -3; }
+  if (fsync_parent(path) != 0) return -7;  // rename published but not durable
   return 0;
 }
 

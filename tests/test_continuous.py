@@ -250,8 +250,7 @@ class TestResetRebuildsDeviceState:
         assert fin is None
         eng.step()
         # simulate the donation outcome of a mid-execution failure
-        for buf in (eng._cache_k, eng._cache_v, eng._kv_len,
-                    eng._last_tok, eng._active):
+        for buf in (*eng._cache, eng._kv_len, eng._last_tok, eng._active):
             buf.delete()
         eng.reset()
         # the engine must serve again, correctly

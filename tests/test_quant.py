@@ -412,18 +412,58 @@ class TestKVQuant:
         )
         assert rel < 0.05, rel
 
-    def test_continuous_engine_rejects_int8_kv(self):
+    def test_continuous_engine_int8_kv_greedy_parity(self):
+        """Continuous batching over an int8 cache: slot-based decode with
+        per-row frontiers must produce the same greedy ids as the one-shot
+        int8-KV engine."""
         cfg = tiny(False)
         params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
-        with pytest.raises(NotImplementedError, match="one-shot-engine only"):
-            ContinuousEngine(
-                cfg, params,
-                engine_config=EngineConfig(
-                    prompt_buckets=(16,), max_batch_size=2, max_seq_len=64,
-                    kv_quant="int8",
-                ),
-                dtypes=DT,
-            )
+        sampling = SamplingConfig(do_sample=False, max_new_tokens=6)
+        ec = EngineConfig(
+            prompt_buckets=(16,), max_batch_size=2, max_seq_len=64,
+            kv_quant="int8",
+        )
+        oracle = InferenceEngine(cfg, params, sampling=sampling, engine_config=ec, dtypes=DT)
+        prompts = [[cfg.bos_token_id, 5, 7, 9], [cfg.bos_token_id, 3]]
+        want = [oracle.generate([p])[0] for p in prompts]
+        eng = ContinuousEngine(cfg, params, sampling=sampling, engine_config=ec, dtypes=DT)
+        assert eng._cache[0].dtype == jnp.int8 and len(eng._cache) == 4
+        for rid, p in enumerate(prompts):
+            _, fin = eng.admit(rid, p, sampling.max_new_tokens)
+            assert fin is None
+        results = {}
+        for _ in range(sampling.max_new_tokens + 1):
+            for rid, toks in eng.step():
+                results[rid] = toks
+            if not eng.has_active():
+                break
+        assert [results[i] for i in range(len(prompts))] == want
+
+    def test_continuous_int8_kv_mid_flight_admission(self):
+        """A request joining mid-generation writes its int8 prompt KV into a
+        free slot and completes with the same ids it gets solo."""
+        cfg = tiny(False)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        sampling = SamplingConfig(do_sample=False, max_new_tokens=6)
+        ec = EngineConfig(
+            prompt_buckets=(16,), max_batch_size=2, max_seq_len=64,
+            kv_quant="int8",
+        )
+        solo = InferenceEngine(
+            cfg, params, sampling=sampling, engine_config=ec, dtypes=DT
+        ).generate([[cfg.bos_token_id, 8, 6]])[0]
+        eng = ContinuousEngine(cfg, params, sampling=sampling, engine_config=ec, dtypes=DT)
+        eng.admit(1, [cfg.bos_token_id, 5, 7, 9], sampling.max_new_tokens)
+        eng.step()
+        eng.step()  # request 1 is two tokens in...
+        eng.admit(2, [cfg.bos_token_id, 8, 6], sampling.max_new_tokens)  # ...2 joins
+        results = {}
+        for _ in range(2 * sampling.max_new_tokens):
+            for rid, toks in eng.step():
+                results[rid] = toks
+            if not eng.has_active():
+                break
+        assert results[2] == solo
 
     def test_tp_generate_matches_single_device_int8_kv(self):
         cfg = tiny(False)

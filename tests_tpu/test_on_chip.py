@@ -276,6 +276,33 @@ class TestContinuousOnChip:
         assert results[1] == want1
         assert results[2] == want2
 
+    def test_continuous_int8_kv_parity_on_chip(self):
+        """Continuous batching over an int8 KV cache on real hardware:
+        quantize-on-write scatter + the q8 decode kernel reproduce the
+        one-shot int8-KV engine's greedy ids."""
+        from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine
+        from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+        from rag_llm_k8s_tpu.models.llama import init_llama_params
+
+        DT = DTypePolicy()
+        cfg = LlamaConfig.tiny()
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        greedy = SamplingConfig(do_sample=False, max_new_tokens=8)
+        ecfg = EngineConfig(
+            prompt_buckets=(16,), max_batch_size=2, max_seq_len=64,
+            kv_quant="int8",
+        )
+        oracle = InferenceEngine(cfg, params, sampling=greedy, engine_config=ecfg, dtypes=DT)
+        want = oracle.generate([[3, 17, 42, 7]])[0]
+        eng = ContinuousEngine(cfg, params, sampling=greedy, engine_config=ecfg, dtypes=DT)
+        assert eng._cache[0].dtype == jnp.int8
+        eng.admit(1, [3, 17, 42, 7], greedy.max_new_tokens)
+        results = {}
+        while eng.has_active():
+            for rid, toks in eng.step():
+                results[rid] = toks
+        assert results[1] == want
+
 
 class Test8BShapesOnChip:
     def test_single_layer_and_lm_head_microbench(self):
