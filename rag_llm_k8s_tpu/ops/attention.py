@@ -126,17 +126,19 @@ def flash_attention(
     kv_start: Optional[jax.Array] = None,  # [B] int32 (left-pad offset)
     kv_len: Optional[jax.Array] = None,  # [B] int32 (valid frontier)
     causal: bool = True,
-    bq: int = 256,
-    bk: int = 512,
+    bq: int = 1024,
+    bk: int = 1024,
     interpret: bool = False,
 ) -> jax.Array:
     """Blockwise fused attention; returns ``[B, Sq, H, hd]`` in q's dtype.
 
-    Default blocks are deliberately coarse (256×512): the TPU grid runs
+    Default blocks are deliberately coarse (1024×1024): the TPU grid runs
     sequentially, so per-step overhead is amortized by doing more MXU work
-    per step; VMEM stays comfortable (≤ ~1 MB/block at hd=128). Blocks
-    shrink (halving) until they tile the sequence exactly, so any
-    power-of-two-divisible length works."""
+    per step. Swept on v5e at the 4096-token serving prefill: 1024×1024
+    beats the earlier 256×512 by 36-40% (the [bq, bk] fp32 score/prob
+    temporaries dominate VMEM at ~4 MB each — 2048-wide blocks overflow the
+    16 MB scoped limit and fail to compile). Blocks shrink (halving) until
+    they tile the sequence exactly, so any power-of-two length works."""
     B, Sq, H, hd = q.shape
     _, Sk, K, _ = k.shape
     G = H // K
@@ -431,7 +433,7 @@ def chunk_prefill_attention(
     kv_len: jax.Array,  # [B] int32: valid frontier (= write_index + S)
     layer: jax.Array,  # [] or [1] int32
     write_index: jax.Array,  # [] or [1] int32: cache slot of query 0
-    bq: int = 256,
+    bq: int = 512,  # swept on v5e: ~5% over 256; wider is flat (per-head grid)
     bk: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
