@@ -44,6 +44,10 @@ class BatchScheduler:
         self.max_wait_ms = max_wait_ms
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
+        # serializes submit's stop-check+enqueue against shutdown's final
+        # drain — without it an item can land in the queue after the drain
+        # and block its (timeout=None) caller forever
+        self._lifecycle_lock = threading.Lock()
         self._worker = threading.Thread(target=self._run, daemon=True, name="batch-scheduler")
         self._worker.start()
 
@@ -56,10 +60,11 @@ class BatchScheduler:
         timeout: Optional[float] = None,
     ) -> List[int]:
         """Blocking: enqueue and wait for this prompt's continuation."""
-        if self._stop.is_set():
-            raise RuntimeError("scheduler is shut down")
         item = _Pending(prompt=list(prompt), max_new=max_new_tokens, seed=seed)
-        self._queue.put(item)
+        with self._lifecycle_lock:  # stop-check + enqueue must be atomic
+            if self._stop.is_set():
+                raise RuntimeError("scheduler is shut down")
+            self._queue.put(item)
         if not item.done.wait(timeout):
             raise TimeoutError("generation timed out")
         if item.error is not None:
@@ -73,6 +78,33 @@ class BatchScheduler:
 
     # ------------------------------------------------------------------
     def _run(self):
+        carry: Optional[_Pending] = None
+        try:
+            carry = self._run_loop()
+        finally:
+            # the worker is exiting for WHATEVER reason (shutdown() or an
+            # unguarded exception): close the door first, or submits racing
+            # this drain would enqueue after it and block forever
+            self._stop.set()
+            # fail everything still queued or carried so no caller blocks
+            # forever on a scheduler that has stopped (the server submits
+            # with timeout=None)
+            err = RuntimeError("scheduler is shut down")
+            leftovers = [carry] if carry is not None else []
+            with self._lifecycle_lock:
+                while True:
+                    try:
+                        queued = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if queued is not None:
+                        leftovers.append(queued)
+            for it in leftovers:
+                it.error = err
+                it.done.set()
+
+    def _run_loop(self) -> Optional[_Pending]:
+        """Returns the un-acked in-hand item (if any) when stopping."""
         carry: Optional[_Pending] = None
         while not self._stop.is_set():
             first = carry if carry is not None else self._queue.get()
@@ -112,3 +144,4 @@ class BatchScheduler:
             finally:
                 for b in batch:
                     b.done.set()
+        return carry

@@ -125,14 +125,14 @@ class ContinuousEngine:
         # (k, v) bf16, or (k, v, k_scale, v_scale) with kv_quant="int8" —
         # the int8 payloads and fp32 scale planes donate/rebuild together
         self._cache = self._fresh_cache()
-        self._kv_start = jnp.zeros((self.B,), jnp.int32)
-        self._kv_len = jnp.zeros((self.B,), jnp.int32)
-        self._last_tok = jnp.zeros((self.B,), jnp.int32)
-        self._active = jnp.zeros((self.B,), bool)
+        self._kv_start = self._put(jnp.zeros((self.B,), jnp.int32))
+        self._kv_len = self._put(jnp.zeros((self.B,), jnp.int32))
+        self._last_tok = self._put(jnp.zeros((self.B,), jnp.int32))
+        self._active = self._put(jnp.zeros((self.B,), bool))
         # per-row PRNG keys: a request's draws are keyed by its own seed and
         # token position, so they do not depend on its batchmates (solo vs
         # shared-batch runs of the same seeded request sample identically)
-        self._rng_keys = jnp.zeros((self.B, 2), jnp.uint32)
+        self._rng_keys = self._put(jnp.zeros((self.B, 2), jnp.uint32))
         self._rng = jax.random.PRNGKey(sampling.seed)  # seedless-key stream
         # ---- host-side bookkeeping -------------------------------------
         self.slots = [_Slot() for _ in range(self.B)]
@@ -150,15 +150,32 @@ class ContinuousEngine:
             self._get("insert", S)
         self._get("step", 0)
 
+    def _put(self, x, sharding=None):
+        """Place a host/device value to match a lowered aval's sharding;
+        identity off-mesh."""
+        if self.mesh is None:
+            return jnp.asarray(x)
+        return jax.device_put(x, sharding or self.mesh.replicated)
+
     def _fresh_cache(self):
-        """The cache-state tuple for the full [B, T] slot block (__init__)."""
-        cache = make_kv_cache(
-            self.config, self.B, self.T, self.dtypes.compute_dtype,
-            quant=self.kv_quant,
-        )
-        if self.kv_quant == "int8":
-            return (cache.k, cache.v, cache.k_scale, cache.v_scale)
-        return (cache.k, cache.v)
+        """The cache-state tuple for the full [B, T] slot block (__init__).
+        On a mesh the zeros are built DIRECTLY sharded (jit with
+        out_shardings) — materializing the full cache on one device and
+        resharding would transiently need tp× the steady per-chip footprint,
+        an OOM risk at construction and at every post-failure reset."""
+
+        def build():
+            cache = make_kv_cache(
+                self.config, self.B, self.T, self.dtypes.compute_dtype,
+                quant=self.kv_quant,
+            )
+            if self.kv_quant == "int8":
+                return (cache.k, cache.v, cache.k_scale, cache.v_scale)
+            return (cache.k, cache.v)
+
+        if self.mesh is None:
+            return build()
+        return jax.jit(build, out_shardings=self._cache_shardings())()
 
     def reset(self):
         """Rebuild ALL device state after a failed step. A step that dies
@@ -168,11 +185,11 @@ class ContinuousEngine:
         while /healthz still reports ready."""
         self.slots = [_Slot() for _ in range(self.B)]
         self._cache = self._fresh_cache()
-        self._kv_start = jnp.zeros((self.B,), jnp.int32)
-        self._kv_len = jnp.zeros((self.B,), jnp.int32)
-        self._last_tok = jnp.zeros((self.B,), jnp.int32)
-        self._active = jnp.zeros((self.B,), bool)
-        self._rng_keys = jnp.zeros((self.B, 2), jnp.uint32)
+        self._kv_start = self._put(jnp.zeros((self.B,), jnp.int32))
+        self._kv_len = self._put(jnp.zeros((self.B,), jnp.int32))
+        self._last_tok = self._put(jnp.zeros((self.B,), jnp.int32))
+        self._active = self._put(jnp.zeros((self.B,), bool))
+        self._rng_keys = self._put(jnp.zeros((self.B, 2), jnp.uint32))
 
     # ------------------------------------------------------------------
     # executables
@@ -187,13 +204,45 @@ class ContinuousEngine:
             self._compiled[key] = fn
         return fn
 
+    def _shardings(self):
+        """(cache_payload, cache_scale, replicated) NamedShardings — or all
+        None off-mesh. The cache shards its kv-head axis over tp (matching
+        the attention kernels' shard_map specs) when head counts divide;
+        everything host-fed is replicated. Executables are lowered with and
+        return EXACTLY these, so state tuples round-trip between prefill →
+        insert → step without 'sharding does not match' rejections (an
+        unsharded lowering bricks every request on a tp>1 mesh)."""
+        if self.mesh is None:
+            return None, None, None
+        rep = self.mesh.replicated
+        K, tp = self.config.num_kv_heads, self.mesh.tp
+        if tp > 1 and K % tp == 0:
+            return (
+                self.mesh.sharding(None, None, "tp", None, None),
+                self.mesh.sharding(None, None, "tp", None),
+                rep,
+            )
+        return rep, rep, rep
+
+    def _cache_shardings(self):
+        """Per-plane shardings for the cache-state tuple (None off-mesh)."""
+        pay, sc, _ = self._shardings()
+        if self.kv_quant == "int8":
+            return (pay, pay, sc, sc)
+        return (pay, pay)
+
     def _cache_avals(self, batch: int, length: int):
-        """ShapeDtypeStructs matching the cache-state tuple."""
+        """ShapeDtypeStructs (with shardings, on-mesh) for the cache tuple."""
         L, K, hd = self.config.num_layers, self.config.num_kv_heads, self.config.head_dim
         cdt = jnp.int8 if self.kv_quant == "int8" else self.dtypes.compute_dtype
-        payload = jax.ShapeDtypeStruct((L, batch, K, length, hd), cdt)
+        shardings = self._cache_shardings()
+        payload = jax.ShapeDtypeStruct(
+            (L, batch, K, length, hd), cdt, sharding=shardings[0]
+        )
         if self.kv_quant == "int8":
-            scale = jax.ShapeDtypeStruct((L, batch, K, length), jnp.float32)
+            scale = jax.ShapeDtypeStruct(
+                (L, batch, K, length), jnp.float32, sharding=shardings[2]
+            )
             return (payload, payload, scale, scale)
         return (payload, payload)
 
@@ -219,11 +268,18 @@ class ContinuousEngine:
             )
             return row, tok0, kv_start[0]
 
-        return jax.jit(prefill).lower(
+        rep = self.mesh.replicated if self.mesh is not None else None
+        # pin output shardings so the row block arrives EXACTLY as insert's
+        # lowered avals expect it (unpinned propagation can pick a different
+        # layout and insert would reject the mismatch at call time)
+        out_shardings = (
+            (self._cache_shardings(), rep, rep) if self.mesh is not None else None
+        )
+        return jax.jit(prefill, out_shardings=out_shardings).lower(
             param_avals(self.params),
-            jax.ShapeDtypeStruct((1, S), jnp.int32),
-            jax.ShapeDtypeStruct((1, S), jnp.int32),
-            jax.ShapeDtypeStruct((2,), jnp.uint32),
+            jax.ShapeDtypeStruct((1, S), jnp.int32, sharding=rep),
+            jax.ShapeDtypeStruct((1, S), jnp.int32, sharding=rep),
+            jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep),
         ).compile()
 
     def _build_insert(self, S: int):
@@ -246,20 +302,25 @@ class ContinuousEngine:
             return cache, kv_start, kv_len, last_tok, active, rng_keys
 
         i32 = jnp.int32
+        rep = self.mesh.replicated if self.mesh is not None else None
+        out_shardings = (
+            (self._cache_shardings(), rep, rep, rep, rep, rep)
+            if self.mesh is not None else None
+        )
         # row_cache is not donated: a [L,1,...] block cannot alias into the
         # [L,B,...] cache, so donation would only emit a warning
-        return jax.jit(insert, donate_argnums=(0, 2, 3, 6)).lower(
+        return jax.jit(insert, donate_argnums=(0, 2, 3, 6), out_shardings=out_shardings).lower(
             self._cache_avals(self.B, self.T),
             self._cache_avals(1, S),
-            jax.ShapeDtypeStruct((self.B,), i32),
-            jax.ShapeDtypeStruct((self.B,), i32),
-            jax.ShapeDtypeStruct((self.B,), i32),
-            jax.ShapeDtypeStruct((self.B,), bool),
-            jax.ShapeDtypeStruct((self.B, 2), jnp.uint32),
-            jax.ShapeDtypeStruct((), i32),
-            jax.ShapeDtypeStruct((), i32),
-            jax.ShapeDtypeStruct((), i32),
-            jax.ShapeDtypeStruct((2,), jnp.uint32),
+            jax.ShapeDtypeStruct((self.B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((self.B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((self.B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((self.B,), bool, sharding=rep),
+            jax.ShapeDtypeStruct((self.B, 2), jnp.uint32, sharding=rep),
+            jax.ShapeDtypeStruct((), i32, sharding=rep),
+            jax.ShapeDtypeStruct((), i32, sharding=rep),
+            jax.ShapeDtypeStruct((), i32, sharding=rep),
+            jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep),
         ).compile()
 
     def _build_step(self, _unused: int = 0):
@@ -295,16 +356,21 @@ class ContinuousEngine:
             return out, kv_len, tok, hit_eos, active
 
         i32 = jnp.int32
+        rep = self.mesh.replicated if self.mesh is not None else None
+        out_shardings = (
+            (self._cache_shardings(), rep, rep, rep, rep)
+            if self.mesh is not None else None
+        )
         # kv_start (2) and rng_keys (6) are NOT donated: neither is among the
         # outputs, and the host keeps using their buffers across steps
-        return jax.jit(step, donate_argnums=(1, 3, 4, 5)).lower(
+        return jax.jit(step, donate_argnums=(1, 3, 4, 5), out_shardings=out_shardings).lower(
             param_avals(self.params),
             self._cache_avals(B, T),
-            jax.ShapeDtypeStruct((B,), i32),
-            jax.ShapeDtypeStruct((B,), i32),
-            jax.ShapeDtypeStruct((B,), i32),
-            jax.ShapeDtypeStruct((B,), bool),
-            jax.ShapeDtypeStruct((B, 2), jnp.uint32),
+            jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), bool, sharding=rep),
+            jax.ShapeDtypeStruct((B, 2), jnp.uint32, sharding=rep),
         ).compile()
 
 
@@ -357,8 +423,8 @@ class ContinuousEngine:
         # position-indexed draw: the first sampled token sits at position
         # len(p); decode steps continue the same fold sequence
         row_cache, tok0, row_start = self._get("prefill", S)(
-            self.params, jnp.asarray(tokens), jnp.asarray(mask),
-            jax.random.fold_in(row_key, len(p)),
+            self.params, self._put(tokens), self._put(mask),
+            self._put(jax.random.fold_in(row_key, len(p))),
         )
         tok0 = int(tok0)
         self.stats.generate_calls += 1
@@ -373,8 +439,8 @@ class ContinuousEngine:
              self._last_tok, self._active, self._rng_keys) = self._get("insert", S)(
                 self._cache, row_cache,
                 self._kv_start, self._kv_len, self._last_tok, self._active,
-                self._rng_keys, jnp.int32(row), row_start, jnp.int32(tok0),
-                row_key,
+                self._rng_keys, self._put(jnp.int32(row)), row_start,
+                self._put(jnp.int32(tok0)), self._put(row_key),
             )
         except BaseException as e:  # noqa: BLE001
             # insert donates the engine's cache/state buffers: a failure
@@ -424,7 +490,7 @@ class ContinuousEngine:
             # device too; EOS rows were already deactivated in-step
             mask = np.ones(self.B, bool)
             mask[deactivate] = False
-            self._active = self._active & jnp.asarray(mask)
+            self._active = self._active & self._put(jnp.asarray(mask))
         return done
 
 

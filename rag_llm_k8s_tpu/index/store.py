@@ -22,7 +22,6 @@ elsewhere) — ``ops/knn.py``.
 
 from __future__ import annotations
 
-import functools
 import hashlib
 import json
 import os
@@ -208,9 +207,10 @@ class VectorStore:
         self._hashes: set = set()
         self.generation = 0
         # device snapshot: padded [cap, D] embeddings + [1, cap] squared
-        # norms. Mutation appends rows IN PLACE on device via
-        # dynamic_update_slice (O(batch) transfer); only outgrowing the
-        # padded bucket forces a full re-upload (O(log N) times ever).
+        # norms. IMMUTABLE pair: mutation swaps in a NEW pair (O(batch)
+        # host transfer + an on-device copy — see _dev_append, never
+        # in-place/donated: concurrent searches hold the old pair); only
+        # outgrowing the padded bucket forces a full re-upload.
         self._dev: Optional[Tuple[jax.Array, jax.Array]] = None
         # observability: ingest-path transfer accounting (tests assert on it)
         self.transfer_stats = {"row_update_batches": 0, "full_uploads": 0}
@@ -265,8 +265,10 @@ class VectorStore:
             return
         rows = np.zeros((n_pad, new_rows.shape[1]), np.float32)
         rows[:n_real] = new_rows
-        # one O(batch) host->device transfer; the donated jit updates the
-        # snapshot in place on device (no O(capacity) copy)
+        # one O(batch) host->device transfer into a NEW snapshot pair —
+        # deliberately not donated/in-place (see _dev_append: concurrent
+        # searches hold the old immutable pair; the device-side O(capacity)
+        # copy is the price of that contract)
         self._dev = _dev_append(
             emb, norms, jnp.asarray(rows), jnp.int32(n_old), jnp.int32(n_real)
         )

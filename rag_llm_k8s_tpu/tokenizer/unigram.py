@@ -32,20 +32,22 @@ class _Trie:
         self.score: float = 0.0
 
 
-def _metaspace_from_spec(spec: dict) -> Tuple[str, bool]:
-    """(replacement, prepend?) from a tokenizer.json pre_tokenizer section.
-    Defaults match SentencePiece exports: ``▁``, always prepended."""
+def _metaspace_from_spec(spec: dict) -> Tuple[str, str]:
+    """(replacement, prepend_scheme) from a tokenizer.json pre_tokenizer.
+    Scheme is HF's: "always" | "first" (only the input's first segment gets
+    the marker — newer SPM exports) | "never". Defaults match SentencePiece
+    exports: ``▁``, always prepended."""
     pre = spec.get("pre_tokenizer") or {}
     nodes = pre.get("pretokenizers", [pre]) if pre.get("type") == "Sequence" else [pre]
     for node in nodes:
         if node.get("type") == "Metaspace":
             repl = node.get("replacement", _SPACE)
             if "prepend_scheme" in node:
-                prepend = node["prepend_scheme"] != "never"
+                scheme = node["prepend_scheme"]
             else:
-                prepend = node.get("add_prefix_space", True)
-            return repl, prepend
-    return _SPACE, True
+                scheme = "always" if node.get("add_prefix_space", True) else "never"
+            return repl, scheme
+    return _SPACE, "always"
 
 
 class UnigramTokenizer:
@@ -59,7 +61,7 @@ class UnigramTokenizer:
         add_bos_eos: bool = True,
         normalize: Optional[Normalizer] = None,
         replacement: str = _SPACE,
-        prepend: bool = True,
+        prepend: object = True,  # bool (legacy) or "always"|"first"|"never"
     ):
         self.pieces = pieces
         self.unk_id = unk_id
@@ -72,6 +74,13 @@ class UnigramTokenizer:
         # (tests, fixtures) on the same behavior as spec-loaded tokenizers
         self.normalize: Normalizer = nmt_nfkc if normalize is None else normalize
         self.replacement = replacement
+        # normalize bool (legacy API) to the HF scheme vocabulary
+        if prepend is True:
+            prepend = "always"
+        elif prepend is False:
+            prepend = "never"
+        if prepend not in ("always", "first", "never"):
+            raise ValueError(f"prepend={prepend!r}: expected always|first|never")
         self.prepend = prepend
         self.id_to_piece = {i: p for i, (p, _) in enumerate(pieces)}
         for t, i in self.special_tokens.items():
@@ -141,14 +150,17 @@ class UnigramTokenizer:
             fused.append(pid)
         return fused
 
-    def _encode_segment(self, text: str) -> List[int]:
-        """Normalize + Metaspace + Viterbi over one special-free span."""
+    def _encode_segment(self, text: str, first: bool = True) -> List[int]:
+        """Normalize + Metaspace + Viterbi over one special-free span.
+        ``first``: whether this span starts the whole input (the
+        "first" prepend scheme marks only that one)."""
         text = self.normalize(text)
         if not text:
             return []
         # Metaspace: spaces → ▁, word-initial ▁ (sentencepiece handling)
         body = text.replace(" ", self.replacement)
-        if self.prepend and not body.startswith(self.replacement):
+        mark = self.prepend == "always" or (self.prepend == "first" and first)
+        if mark and not body.startswith(self.replacement):
             body = self.replacement + body
         return self._viterbi(body)
 
@@ -160,10 +172,10 @@ class UnigramTokenizer:
             ids = []
             pos = 0
             for m in self._special_re.finditer(text):
-                ids.extend(self._encode_segment(text[pos : m.start()]))
+                ids.extend(self._encode_segment(text[pos : m.start()], first=pos == 0))
                 ids.append(self.special_tokens[m.group()])
                 pos = m.end()
-            ids.extend(self._encode_segment(text[pos:]))
+            ids.extend(self._encode_segment(text[pos:], first=pos == 0))
         if add_special and self.bos_id is not None and self.eos_id is not None:
             return [self.bos_id] + ids + [self.eos_id]
         return ids

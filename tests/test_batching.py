@@ -1,6 +1,7 @@
 """Continuous batching: concurrent submits coalesce and return correct results."""
 
 import threading
+import time
 
 import jax
 import pytest
@@ -61,6 +62,61 @@ class TestBatchScheduler:
         sched.shutdown()
         with pytest.raises(RuntimeError):
             sched.submit([1, 2, 3])
+
+    def test_shutdown_drains_queued_and_carried(self, engine):
+        """Items still queued (or held as the mismatch carry) at shutdown
+        must be FAILED, not abandoned — the server submits with
+        timeout=None, so an un-acked item would block its HTTP thread
+        forever."""
+        sched = BatchScheduler(engine, max_wait_ms=700.0)
+        release = threading.Event()
+        orig_generate = sched.engine.generate
+
+        def slow_generate(*a, **kw):
+            release.wait(timeout=30)
+            return orig_generate(*a, **kw)
+
+        sched.engine.generate = slow_generate
+        try:
+            results = {}
+
+            def run(name, max_new):
+                try:
+                    results[name] = ("ok", sched.submit(
+                        [3, 17], max_new_tokens=max_new, timeout=60
+                    ))
+                except BaseException as e:  # noqa: BLE001
+                    results[name] = ("err", type(e).__name__)
+
+            # t1 leads; t2 arrives DURING t1's coalescing window with a
+            # mismatched max_new, so the worker holds it as the CARRY and
+            # proceeds into (blocked) generate; t3 then sits on the queue —
+            # shutdown must fail both drain paths (carry AND queue)
+            threads = [
+                threading.Thread(target=run, args=("t1", 2)),
+                threading.Thread(target=run, args=("t2", 3)),
+                threading.Thread(target=run, args=("t3", 4)),
+            ]
+            threads[0].start()
+            time.sleep(0.2)  # worker picked t1, is inside the drain window
+            threads[1].start()
+            time.sleep(0.2)  # worker carried t2, entered blocked generate
+            threads[2].start()
+            time.sleep(0.2)  # t3 queued behind the in-flight batch
+            sched._stop.set()
+            release.set()  # unblock the in-flight batch
+            sched._queue.put(None)
+            sched._worker.join(timeout=30)
+            for t in threads:
+                t.join(timeout=30)
+                assert not t.is_alive(), "submitter hung after shutdown"
+            # the in-flight batch completes; carried + queued fail loudly
+            assert results["t1"][0] == "ok"
+            assert results["t2"] == ("err", "RuntimeError")
+            assert results["t3"] == ("err", "RuntimeError")
+        finally:
+            sched.engine.generate = orig_generate
+            sched.shutdown()
 
 
 class TestNoReorderOnMismatch:
