@@ -79,21 +79,34 @@ def _to_numpy(t) -> np.ndarray:
 
 def _quantize_np(arr: np.ndarray, axis: int):
     """Host-side symmetric per-output-channel int8 (the numpy twin of
-    ``models.llama._quantize_leaf``). Stacked ``[L, in, out]`` groups process
-    one layer at a time so the fp32 transient is one layer, not the group."""
+    ``models.llama._quantize_leaf``). All paths are CHUNKED so the fp32
+    transient stays at ~hundreds of MB regardless of tensor size — a naive
+    whole-tensor pass holds ~3 fp32 copies (cast + |w| + rounded quotient),
+    which for a 70B lm_head (2.1 GiB bf16) is a ~13 GiB spike that defeats
+    the streaming loader's whole memory contract (caught by
+    tests/test_loader_70b.py's transient bound)."""
     if arr.ndim == 3:
         assert axis == 1
         out_q = np.empty(arr.shape, np.int8)
         scales = np.empty((arr.shape[0], arr.shape[2]), np.float32)
         for layer in range(arr.shape[0]):
-            w = arr[layer].astype(np.float32)
-            s = np.maximum(np.abs(w).max(axis=0) / 127.0, 1e-8)
-            out_q[layer] = np.round(w / s)
-            scales[layer] = s
+            out_q[layer], scales[layer] = _quantize_np(arr[layer], 0)
         return out_q, scales
-    w = arr.astype(np.float32)
-    s = np.maximum(np.abs(w).max(axis=axis) / 127.0, 1e-8).astype(np.float32)
-    return np.round(w / np.expand_dims(s, axis)).astype(np.int8), s
+    keep = 1 - axis  # the per-channel (scale) axis
+    out_q = np.empty(arr.shape, np.int8)
+    scales = np.empty(arr.shape[keep], np.float32)
+    # ~64 MB of fp32 per chunk along the channel axis
+    step = max(1, (64 << 20) // max(arr.shape[axis] * 4, 1))
+    for c0 in range(0, arr.shape[keep], step):
+        c1 = min(c0 + step, arr.shape[keep])
+        sl = [slice(None), slice(None)]
+        sl[keep] = slice(c0, c1)
+        sl = tuple(sl)
+        w = arr[sl].astype(np.float32)
+        s = np.maximum(np.abs(w).max(axis=axis) / 127.0, 1e-8)
+        out_q[sl] = np.round(w / np.expand_dims(s, axis))
+        scales[c0:c1] = s
+    return out_q, scales
 
 
 def convert_hf_state_dict(
