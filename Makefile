@@ -27,9 +27,12 @@ bench:
 validate-8b:
 	python scripts/validate_8b.py
 
+validate-70b:
+	python -m pytest tests/test_loader_70b.py -q
+
 check: test tpu-test bench
 	python -c "from __graft_entry__ import entry; import jax; fn, a = entry(); jax.jit(fn).lower(*a).compile(); print('entry: compile OK')"
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8); print('dryrun_multichip(8): OK')"
 
-.PHONY: test tpu-test bench check validate-8b
+.PHONY: test tpu-test bench check validate-8b validate-70b
