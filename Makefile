@@ -27,6 +27,10 @@ bench:
 validate-8b:
 	python scripts/validate_8b.py
 
+# CI-sized: streams ONE true-shape 70B layer in the int8 deployment mode
+# (unlike validate-8b there is no separate full-depth script — a full 70B
+# checkpoint is ~140 GB, beyond this environment's disk; the per-layer
+# shapes and tp=8 shardings are what the single-layer proof pins)
 validate-70b:
 	python -m pytest tests/test_loader_70b.py -q
 
