@@ -34,6 +34,103 @@ class _Pending:
     error: Optional[BaseException] = None
 
 
+@dataclass
+class _PendingItem:
+    value: object
+    done: threading.Event = field(default_factory=threading.Event)
+    result: object = None
+    error: Optional[BaseException] = None
+
+
+class Coalescer:
+    """Generic blocking coalescer: concurrent ``submit(x)`` calls are grouped
+    and served by ONE ``batch_fn([x, ...])`` call on a worker thread.
+
+    This is the serving fix for the *retrieval* stage: without it, N
+    concurrent queries dispatch N separate fused embed+kNN device calls that
+    serialize on the device queue (and, over a tunneled TPU, pay a
+    device→host fetch each). Coalesced, the first query runs while the rest
+    accumulate, and the entire remainder runs as one batched device call —
+    the same continuous-batching effect the decode path already gets from
+    :class:`BatchScheduler`, applied to embed+kNN.
+
+    ``max_wait_ms`` can stay tiny (even 0): while the worker is busy with one
+    batch, later arrivals queue up and form the next batch naturally.
+    """
+
+    def __init__(self, batch_fn, max_batch: int, max_wait_ms: float = 2.0):
+        self.batch_fn = batch_fn
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
+        self._queue: "queue.Queue[_PendingItem]" = queue.Queue()
+        self._stop = threading.Event()
+        self._lifecycle_lock = threading.Lock()
+        self._worker = threading.Thread(target=self._run, daemon=True, name="coalescer")
+        self._worker.start()
+
+    def submit(self, value, timeout: Optional[float] = None):
+        item = _PendingItem(value=value)
+        with self._lifecycle_lock:  # stop-check + enqueue must be atomic
+            if self._stop.is_set():
+                raise RuntimeError("coalescer is shut down")
+            self._queue.put(item)
+        if not item.done.wait(timeout):
+            raise TimeoutError("coalesced call timed out")
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def shutdown(self):
+        self._stop.set()
+        self._queue.put(None)
+        self._worker.join(timeout=5)
+
+    def _run(self):
+        try:
+            while not self._stop.is_set():
+                first = self._queue.get()
+                if first is None:
+                    continue
+                batch = [first]
+                while len(batch) < self.max_batch:
+                    try:
+                        nxt = self._queue.get(timeout=self.max_wait_ms / 1e3)
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        break
+                    batch.append(nxt)
+                try:
+                    results = self.batch_fn([b.value for b in batch])
+                    if len(results) != len(batch):
+                        raise RuntimeError(
+                            f"batch_fn returned {len(results)} results for "
+                            f"{len(batch)} items"
+                        )
+                    for b, r in zip(batch, results):
+                        b.result = r
+                except BaseException as e:  # noqa: BLE001 — deliver to all waiters
+                    for b in batch:
+                        b.error = e
+                finally:
+                    for b in batch:
+                        b.done.set()
+        finally:
+            # close the door, then fail everything still queued so no caller
+            # blocks forever on a dead worker (submits use timeout=None)
+            self._stop.set()
+            err = RuntimeError("coalescer is shut down")
+            with self._lifecycle_lock:
+                while True:
+                    try:
+                        queued = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if queued is not None:
+                        queued.error = err
+                        queued.done.set()
+
+
 class BatchScheduler:
     def __init__(
         self,

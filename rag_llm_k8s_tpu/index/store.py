@@ -95,6 +95,8 @@ def _save_vectors(vec_path: str, vectors: np.ndarray, generation: int) -> str:
     try:
         with os.fdopen(fd, "wb") as f:
             np.save(f, vectors)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, vec_path)
     except BaseException:
         if os.path.exists(tmp):

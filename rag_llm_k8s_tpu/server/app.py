@@ -86,8 +86,21 @@ class RagService:
         self.scheduler = scheduler
         self.metrics = _Metrics()
         self.ready = False
-        # compiled fused embed+kNN executables, keyed (bucket, index_pad, k)
+        # compiled fused embed+kNN executables, keyed (bucket, index_pad, k, B)
         self._fused_retrieve: Dict[tuple, object] = {}
+        # concurrent serving: coalesce the embed+kNN stage too — without
+        # this, N concurrent queries serialize N fused-retrieve device calls
+        # ahead of the (already coalesced) generate stage
+        self._retrieve_cap = 8
+        self.retrieve_coalescer = None
+        if scheduler is not None:
+            from rag_llm_k8s_tpu.engine.batching import Coalescer
+
+            # window 0: busy-worker accumulation already batches under load,
+            # and a nonzero window would tax every uncontended query
+            self.retrieve_coalescer = Coalescer(
+                self._retrieve_many, max_batch=self._retrieve_cap, max_wait_ms=0.0
+            )
         # ONE EOS policy for ingest and query truncation alike: default the
         # runner's eos from the tokenizer so the two paths cannot diverge
         if encoder is not None and getattr(encoder, "eos_id", None) is None:
@@ -132,6 +145,8 @@ class RagService:
                     k[1] == cap and k[2] == k_eff for k in self._fused_retrieve
                 ):
                     self._retrieve("warmup")
+                    if self.retrieve_coalescer is not None:
+                        self._retrieve_many(["warmup"] * self._retrieve_cap)
             except Exception:  # noqa: BLE001 — warmup must not fail ingest
                 logger.exception("post-ingest retrieval warmup failed")
         self.metrics.observe("ingest_seconds", time.monotonic() - t0)
@@ -163,6 +178,16 @@ class RagService:
         kNN dispatch) pays two device-call latencies per query — fusing
         keeps the query vector on device between the encoder and the kNN
         kernel (survey §7 hard part (e)) and halves dispatch overhead."""
+        return self._retrieve_many([text])[0]
+
+    def _retrieve_many(self, texts: List[str]):
+        """Batched fused embed+kNN: N queries → ONE device call per length
+        bucket (in practice one — queries are short). Query batches > 1 pad
+        to the fixed ``_retrieve_cap`` so concurrency costs exactly ONE extra
+        executable, not a ladder; the padded rows ride along free (the
+        encoder forward at these lengths is weight-bandwidth-bound, so B=8
+        costs barely more than B=1). Returns ``[(results, tokenize_ms)]``
+        in input order."""
         import jax
         import jax.numpy as jnp
 
@@ -170,30 +195,52 @@ class RagService:
 
         n = self.store.ntotal
         if n == 0:
-            return [], 0.0
+            return [([], 0.0)] * len(texts)
         k_eff = min(self.config.retrieval.k, n)
         emb, norms = self.store.device_snapshot()
-        t0 = time.monotonic()
         # the runner's own bucketing/truncation/EOS rules (its buckets are
         # already clamped to max_encode_len) — query and chunk embeddings go
         # through identical preparation
-        tokens, mask = self.encoder.prepare_batch(self.encoder_tokenizer.encode(text))
-        tokenize_ms = (time.monotonic() - t0) * 1e3
+        prepped = []
+        for text in texts:
+            t0 = time.monotonic()
+            tokens, mask = self.encoder.prepare_batch(self.encoder_tokenizer.encode(text))
+            prepped.append((tokens, mask, (time.monotonic() - t0) * 1e3))
 
-        key = (tokens.shape[1], emb.shape[0], k_eff)
-        fn = self._fused_retrieve.get(key)
-        if fn is None:
-            model = self.encoder.model
+        out: List = [None] * len(texts)
+        by_bucket: Dict[int, List[int]] = {}
+        for i, (tokens, _, _) in enumerate(prepped):
+            by_bucket.setdefault(tokens.shape[1], []).append(i)
+        for S, idxs in by_bucket.items():
+            for start in range(0, len(idxs), self._retrieve_cap):
+                group = idxs[start : start + self._retrieve_cap]
+                B_pad = 1 if len(group) == 1 else self._retrieve_cap
+                tokens = np.full((B_pad, S), self.config.encoder.pad_token_id, np.int32)
+                mask = np.zeros((B_pad, S), np.int32)
+                for row, i in enumerate(group):
+                    tokens[row], mask[row] = prepped[i][0][0], prepped[i][1][0]
 
-            def fused(params, tokens, mask, emb, norms):
-                vec = model.apply({"params": params}, tokens, mask)
-                return knn_topk(vec.astype(jnp.float32), emb, norms, k=k_eff)
+                key = (S, emb.shape[0], k_eff, B_pad)
+                fn = self._fused_retrieve.get(key)
+                if fn is None:
+                    model = self.encoder.model
 
-            fn = jax.jit(fused)
-            self._fused_retrieve[key] = fn
-        dists, idx = fn(self.encoder.params, jnp.asarray(tokens), jnp.asarray(mask), emb, norms)
-        dists, idx = np.asarray(dists[0]), np.asarray(idx[0])
-        return self.store.results_at(idx, dists), tokenize_ms
+                    def fused(params, tokens, mask, emb, norms):
+                        vec = model.apply({"params": params}, tokens, mask)
+                        return knn_topk(vec.astype(jnp.float32), emb, norms, k=k_eff)
+
+                    fn = jax.jit(fused)
+                    self._fused_retrieve[key] = fn
+                dists, idx = fn(
+                    self.encoder.params, jnp.asarray(tokens), jnp.asarray(mask), emb, norms
+                )
+                dists, idx = np.asarray(dists), np.asarray(idx)
+                for row, i in enumerate(group):
+                    out[i] = (
+                        self.store.results_at(idx[row], dists[row]),
+                        prepped[i][2],
+                    )
+        return out
 
     # -- query ----------------------------------------------------------
     def answer(self, user_prompt: str) -> Dict:
@@ -205,7 +252,10 @@ class RagService:
         # old embed_ms/retrieve_ms split (which would silently skew any
         # cross-version comparison of stage timings)
         t0 = time.monotonic()
-        results, tokenize_ms = self._retrieve(user_prompt)
+        if self.retrieve_coalescer is not None:
+            results, tokenize_ms = self.retrieve_coalescer.submit(user_prompt)
+        else:
+            results, tokenize_ms = self._retrieve(user_prompt)
         timings["tokenize_ms"] = tokenize_ms
         timings["embed_retrieve_ms"] = (time.monotonic() - t0) * 1e3 - tokenize_ms
 
@@ -326,9 +376,19 @@ class RagService:
                 sizes.append(b)
                 b *= 2
             if sizes:
-                serving_engine.warmup(
-                    batch_sizes=tuple(sizes), buckets=(max(ec.prompt_buckets),)
-                )
+                # Coverage trade-off: RAG prompts carry a full 3-chunk context
+                # and land in the LARGEST bucket, so by default only that
+                # bucket's batch ladder is warmed — a concurrent burst of
+                # short, context-free prompts still pays a per-(batch,bucket)
+                # compile mid-request. Deployments that expect such bursts set
+                # TPU_RAG_WARM_FULL_LADDER=1 to warm every (batch, bucket)
+                # pair at startup instead (readiness arrives later: one
+                # compile per pair).
+                if os.environ.get("TPU_RAG_WARM_FULL_LADDER") == "1":
+                    warm_buckets = tuple(ec.prompt_buckets)
+                else:
+                    warm_buckets = (max(ec.prompt_buckets),)
+                serving_engine.warmup(batch_sizes=tuple(sizes), buckets=warm_buckets)
         if serving_engine is not self.engine:
             # over-bucket prompts bypass the scheduler into the one-shot
             # engine's chunked prefill — warm one representative overflow
@@ -342,7 +402,17 @@ class RagService:
         # compile the fused embed+kNN executable and upload the index
         # snapshot (no-op while the index is empty; ingest re-warms)
         self._retrieve("warmup")
+        if self.retrieve_coalescer is not None and self.store.ntotal:
+            # one extra executable: the padded concurrent-retrieval batch
+            self._retrieve_many(["warmup"] * self._retrieve_cap)
         self.ready = True
+
+    def shutdown(self):
+        """Stop the serving threads (coalescers/schedulers). Idempotent."""
+        if self.retrieve_coalescer is not None:
+            self.retrieve_coalescer.shutdown()
+        if self.scheduler is not None:
+            self.scheduler.shutdown()
 
 
 class WsgiApp:
@@ -444,7 +514,27 @@ class WsgiApp:
                 "index_vectors": self.service.store.ntotal,
             }
         )
-        return self._jsonify(snap)
+        # Prometheus text exposition by default so a scraper can actually
+        # consume this (survey §5); the JSON shape stays available under
+        # Accept: application/json for humans and the existing tests
+        if "application/json" in (request.headers.get("Accept") or ""):
+            return self._jsonify(snap)
+        import re as _re
+
+        lines = []
+        # everything _Metrics records is monotonic (inc/observe only ever
+        # add); the only level-valued sample in the snapshot is the live
+        # index size
+        gauges = {"index_vectors"}
+        for key in sorted(snap):
+            name = "tpu_rag_" + _re.sub(r"[^a-zA-Z0-9_]", "_", str(key))
+            kind = "gauge" if key in gauges else "counter"
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {float(snap[key])!r}")
+        body = "\n".join(lines) + "\n"
+        return self._Response(
+            body, status=200, content_type="text/plain; version=0.0.4; charset=utf-8"
+        )
 
     def ep_profile(self, request):
         """Capture a jax.profiler device trace around one sample query

@@ -136,9 +136,27 @@ class TestRoutes:
 
     def test_healthz_and_metrics(self, client):
         assert client.get("/healthz").status_code == 200
-        m = client.get("/metrics").get_json()
+        m = client.get("/metrics", headers={"Accept": "application/json"}).get_json()
         assert m["index_vectors"] >= 1
         assert m["engine_generate_calls"] >= 1
+
+    def test_metrics_prometheus_exposition(self, client):
+        # the default (no Accept) output must be scrapable text exposition
+        r = client.get("/metrics")
+        assert r.status_code == 200
+        assert r.content_type.startswith("text/plain")
+        text = r.get_data(as_text=True)
+        lines = [l for l in text.splitlines() if l]
+        assert any(l.startswith("# TYPE tpu_rag_") for l in lines)
+        samples = {}
+        for l in lines:
+            if l.startswith("#"):
+                continue
+            name, val = l.rsplit(" ", 1)
+            float(val)  # every sample parses as a number
+            samples[name] = float(val)
+        assert samples["tpu_rag_index_vectors"] >= 1
+        assert samples["tpu_rag_engine_generate_calls"] >= 1
 
     def test_ingest_idempotent_via_http(self, client):
         pdf = make_pdf("deduplicated content should index once")
@@ -265,3 +283,88 @@ class TestLongPromptRouting:
         svc.answer("x" * 1200)  # long: prompt exceeds bucket 512 -> engine path
         assert svc.scheduler.submitted == before  # scheduler NOT used
         assert any(k[3] == 512 for k in engine._compiled)  # chunked exe ran
+
+
+class TestCoalescedRetrieval:
+    """Under concurrency the embed+kNN stage batches into one fused device
+    call (RagService.retrieve_coalescer) — results must match the solo path
+    exactly, and concurrent /query must return the sequential answers."""
+
+    def _make_service(self, with_scheduler: bool):
+        from rag_llm_k8s_tpu.engine.batching import BatchScheduler
+
+        llama_cfg = LlamaConfig.tiny(vocab_size=300)
+        enc_cfg = EncoderConfig.tiny(vocab_size=300)
+        cfg = AppConfig(model=llama_cfg, encoder=enc_cfg)
+        engine = InferenceEngine(
+            llama_cfg,
+            init_llama_params(jax.random.PRNGKey(0), llama_cfg, FP32),
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=4),
+            engine_config=EngineConfig(prompt_buckets=(128,), max_batch_size=4),
+            dtypes=FP32,
+        )
+        encoder = EncoderRunner(
+            enc_cfg,
+            init_encoder_params(jax.random.PRNGKey(1), enc_cfg, FP32),
+            dtypes=FP32, length_buckets=(32,), max_batch=4,
+        )
+        store = VectorStore(dim=enc_cfg.hidden_size)
+        scheduler = BatchScheduler(engine, max_wait_ms=20.0) if with_scheduler else None
+        svc = RagService(cfg, engine, ByteTokenizer(), encoder, ByteTokenizer(),
+                         store, scheduler=scheduler)
+        svc.ready = True
+        texts = ["alpha beta gamma", "delta epsilon", "zeta eta theta iota"]
+        vecs = encoder.encode([ByteTokenizer().encode(t) for t in texts])
+        store.add(list(vecs), [
+            {"filename": "f", "chunk_id": i, "text": t} for i, t in enumerate(texts)
+        ])
+        return svc
+
+    def test_retrieve_many_matches_solo(self):
+        svc = self._make_service(with_scheduler=False)
+        queries = ["alpha", "epsilon delta", "theta", "gamma beta alpha"]
+        solo = [svc._retrieve(q)[0] for q in queries]
+        batched = [r for r, _ in svc._retrieve_many(queries)]
+        assert len(batched) == len(solo)
+        for s, b in zip(solo, batched):
+            assert [r.metadata["chunk_id"] for r in s] == [r.metadata["chunk_id"] for r in b]
+            np.testing.assert_allclose(
+                [r.distance for r in s], [r.distance for r in b], rtol=1e-5, atol=1e-6
+            )
+        # the batch used ONE padded executable (B=cap), not one per query
+        assert any(k[3] == svc._retrieve_cap for k in svc._fused_retrieve)
+
+    def test_concurrent_queries_match_sequential(self):
+        import threading
+
+        svc = self._make_service(with_scheduler=True)
+        assert svc.retrieve_coalescer is not None
+        queries = ["alpha", "epsilon delta", "theta iota", "gamma"]
+        try:
+            want = {}
+            for q in queries:
+                # sequential answers through the full serving path
+                want[q] = svc.answer(q)["generated_text"]
+            got = {}
+            errors = []
+
+            def run(q):
+                try:
+                    got[q] = svc.answer(q)["generated_text"]
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=run, args=(q,)) for q in queries]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors, errors
+            assert got == want
+        finally:
+            svc.shutdown()
+
+    def test_shutdown_is_idempotent(self):
+        svc = self._make_service(with_scheduler=True)
+        svc.shutdown()
+        svc.shutdown()
