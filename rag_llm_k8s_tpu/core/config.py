@@ -294,6 +294,14 @@ class EngineConfig:
     # every decode step streams, and fits 8B weights on one 16 GB chip;
     # see models.llama.quantize_llama_params). Training always stays bf16.
     weight_quant: str = "bf16"
+    # continuous engine: decode steps executed per host sync. 1 = admit and
+    # retire between every step (lowest admission latency). >1 runs k steps
+    # as ONE device program (lax.scan) and fetches the [k, B] token plane
+    # once — amortizes per-step dispatch/fetch latency (decisive when the
+    # host link is slow, e.g. a tunneled TPU at ~200 ms/fetch) at the cost
+    # of up to k-1 wasted row-steps after a row finishes mid-window and up
+    # to k steps of admission latency for a waiting request.
+    decode_sync_steps: int = 1
     # KV-cache storage: "bf16" (exact) or "int8" (one fp32 scale per
     # (token, kv-head) vector — halves the cache bytes every decode step
     # scans AND the cache HBM footprint; with a 4096-token prompt bucket the
@@ -404,6 +412,11 @@ class AppConfig:
                     f"TPU_RAG_KV_QUANT={kvq!r}: expected 'bf16' or 'int8'"
                 )
             engine = dataclasses.replace(engine, kv_quant=kvq)
+        if "TPU_RAG_SYNC_STEPS" in env:
+            k = int(env["TPU_RAG_SYNC_STEPS"])
+            if k < 1:
+                raise ValueError(f"TPU_RAG_SYNC_STEPS={k}: expected >= 1")
+            engine = dataclasses.replace(engine, decode_sync_steps=k)
         return dataclasses.replace(
             cfg, server=server, mesh=mesh, sampling=sampling, engine=engine
         )

@@ -17,11 +17,14 @@ Anatomy (all AOT-compiled, static shapes):
 - ``_prefill(S)``: one B=1 forward over a bucketed prompt → that row's
   ``[L, 1, K, S, hd]`` KV block + the first sampled token;
 - ``_insert(S)``: splice the KV block + per-row state into slot ``row``;
-- ``_step``: ONE decode token for all ``B`` slots (per-row windows mask
-  inactive/mismatched rows), returning tokens to the host — a ``B``-int
-  transfer per step, overlapped with the next admission check.
+- ``_step``: ``decode_sync_steps`` decode tokens for all ``B`` slots (per-row
+  windows mask inactive/mismatched rows) as one device program, returning a
+  ``[k, B]`` token plane to the host — one transfer per window, overlapped
+  with the next admission check. ``k = 1`` admits between every token;
+  ``k > 1`` amortizes dispatch/fetch latency (decisive on a slow host link)
+  for up to ``k`` steps of admission latency.
 
-Trade-off vs the fused one-shot path (engine.py): per-step host sync and a
+Trade-off vs the fused one-shot path (engine.py): per-window host sync and a
 scatter cache write, in exchange for no head-of-line blocking. The one-shot
 path remains the fastest way to run a KNOWN batch (bench.py uses it).
 """
@@ -95,6 +98,7 @@ class ContinuousEngine:
         self.mesh = mesh
         self.pad_id = pad_id
         self.B = engine_config.max_batch_size
+        self.sync_steps = max(1, engine_config.decode_sync_steps)
         self.T = -(-engine_config.max_seq_len // 128) * 128
         # only buckets that leave decode room fit a slot; an empty ladder is
         # a config error — fail at construction, not per-request
@@ -148,7 +152,7 @@ class ContinuousEngine:
                 continue  # admit can never use a bucket without decode room
             self._get("prefill", S)
             self._get("insert", S)
-        self._get("step", 0)
+        self._get("step", self.sync_steps)
 
     def _put(self, x, sharding=None):
         """Place a host/device value to match a lowered aval's sharding;
@@ -323,18 +327,24 @@ class ContinuousEngine:
             jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep),
         ).compile()
 
-    def _build_step(self, _unused: int = 0):
+    def _build_step(self, k: int = 1):
+        """The decode executable: ``k`` decode steps for all ``B`` slots as
+        ONE device program, returning the ``[k, B]`` token/EOS planes in a
+        single host fetch. ``k == 1`` is the classic per-step sync; ``k > 1``
+        (``EngineConfig.decode_sync_steps``) scans the step body on device —
+        on-device EOS masking makes the blind multi-step correct (a finished
+        row stops attending/advancing mid-window), the host just discards
+        anything a row produced after its EOS or budget."""
         cfg, dt, sampling = self.config, self.dtypes, self.sampling
         model = self.model_step
         eos_ids = cfg.eos_token_ids
         B, T = self.B, self.T
         kv_quant = self.kv_quant
+        from rag_llm_k8s_tpu.models.llama import KVCache
 
-        def step(params, cache_t, kv_start, kv_len, last_tok, active, rng_keys):
+        def one(params, cache_t, kv_start, kv_len, last_tok, active, rng_keys):
             wi = jnp.where(active, kv_len, 0)  # inactive rows park at slot 0
             posv = jnp.clip(wi - kv_start, 0)  # inactive rows: junk, masked
-            from rag_llm_k8s_tpu.models.llama import KVCache
-
             logits, cache = model.apply(
                 {"params": params}, last_tok[:, None], posv[:, None],
                 KVCache(*cache_t), kv_start, wi + 1, wi,
@@ -355,10 +365,29 @@ class ContinuousEngine:
             )
             return out, kv_len, tok, hit_eos, active
 
+        def step(params, cache_t, kv_start, kv_len, last_tok, active, rng_keys):
+            if k == 1:
+                cache_t, kv_len, tok, hit_eos, active = one(
+                    params, cache_t, kv_start, kv_len, last_tok, active, rng_keys
+                )
+                return cache_t, kv_len, tok, tok[None], hit_eos[None], active
+
+            def body(carry, _):
+                cache_t, kv_len, last_tok, active = carry
+                cache_t, kv_len, tok, hit_eos, active = one(
+                    params, cache_t, kv_start, kv_len, last_tok, active, rng_keys
+                )
+                return (cache_t, kv_len, tok, active), (tok, hit_eos)
+
+            (cache_t, kv_len, tok, active), (toks, eoss) = jax.lax.scan(
+                body, (cache_t, kv_len, last_tok, active), None, length=k
+            )
+            return cache_t, kv_len, tok, toks, eoss, active
+
         i32 = jnp.int32
         rep = self.mesh.replicated if self.mesh is not None else None
         out_shardings = (
-            (self._cache_shardings(), rep, rep, rep, rep)
+            (self._cache_shardings(), rep, rep, rep, rep, rep)
             if self.mesh is not None else None
         )
         # kv_start (2) and rng_keys (6) are NOT donated: neither is among the
@@ -457,30 +486,34 @@ class ContinuousEngine:
         return row, None
 
     def step(self) -> List[Tuple[int, List[int]]]:
-        """One decode step for every active slot. Returns completed requests
-        as ``(request_id, tokens)`` and frees their slots."""
-        (self._cache, self._kv_len, tok, hit_eos,
-         self._active) = self._get("step", 0)(
+        """``decode_sync_steps`` decode steps for every active slot in one
+        device call + one host fetch. Returns completed requests as
+        ``(request_id, tokens)`` and frees their slots."""
+        k = self.sync_steps
+        (self._cache, self._kv_len, self._last_tok, toks, eoss,
+         self._active) = self._get("step", k)(
             self.params, self._cache, self._kv_start,
             self._kv_len, self._last_tok, self._active, self._rng_keys,
         )
-        self._last_tok = tok
-        self.steps += 1
-        tok_h = np.asarray(tok)
-        eos_h = np.asarray(hit_eos)
+        self.steps += k
+        tok_h = np.asarray(toks)  # [k, B]
+        eos_h = np.asarray(eoss)
         done: List[Tuple[int, List[int]]] = []
         deactivate = []
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
             finished = False
-            if eos_h[i]:
-                finished = True  # EOS token itself is not emitted
-            else:
-                slot.tokens.append(int(tok_h[i]))
+            for j in range(k):
+                if eos_h[j, i]:
+                    finished = True  # EOS token itself is not emitted
+                    break
+                slot.tokens.append(int(tok_h[j, i]))
                 slot.remaining -= 1
                 self.stats.decode_tokens += 1
-                finished = slot.remaining <= 0
+                if slot.remaining <= 0:
+                    finished = True  # later window tokens (if any) discarded
+                    break
             if finished:
                 done.append((slot.request_id, slot.tokens))
                 slot.active = False

@@ -356,3 +356,114 @@ class TestShutdownDrainsWaiters:
         t.join(timeout=30)
         assert not t.is_alive(), "caller still blocked after shutdown"
         assert errors and "shut down" in str(errors[0])
+
+
+class TestMultiStepSync:
+    """decode_sync_steps > 1: k decode steps run as ONE device program
+    (lax.scan) with a single [k, B] host fetch — outputs must be identical
+    to per-step sync, including EOS mid-window and budget mid-window."""
+
+    def _engine(self, cfg, params, k, sampling=GREEDY, eng_cfg=ENG_CFG):
+        import dataclasses
+        return ContinuousEngine(
+            cfg, params, sampling=sampling,
+            engine_config=dataclasses.replace(eng_cfg, decode_sync_steps=k),
+            dtypes=FP32,
+        )
+
+    def _drain(self, eng, reqs):
+        results = {}
+        for rid, p, mn in reqs:
+            _, finished = eng.admit(rid, p, mn)
+            if finished is not None:
+                results[rid] = finished
+        for _ in range(200):
+            for rid, toks in eng.step():
+                results[rid] = toks
+            if not eng.has_active():
+                break
+        return results
+
+    def test_greedy_parity_with_per_step_sync(self, setup):
+        cfg, params, oracle = setup
+        prompts = [[3, 17, 42, 7, 99], [5, 5, 8], [11] * 12, [2, 9]]
+        want = {i: oracle.generate([p])[0] for i, p in enumerate(prompts)}
+        for k in (3, 8):
+            eng = self._engine(cfg, params, k)
+            got = self._drain(eng, [(i, p, GREEDY.max_new_tokens) for i, p in enumerate(prompts)])
+            assert got == want, f"k={k}"
+
+    def test_budget_ends_mid_window(self, setup):
+        """max_new not a multiple of k: the extra window steps the device ran
+        past the budget must be discarded, not emitted."""
+        cfg, params, oracle = setup
+        p = [3, 17, 42, 7, 99]
+        want = oracle.generate([p], max_new_tokens=5)[0]
+        eng = self._engine(cfg, params, 4)
+        got = self._drain(eng, [(1, p, 5)])
+        assert got[1] == want
+        assert len(got[1]) == len(want) == 5
+
+    def test_mid_flight_admission_between_windows(self, setup):
+        cfg, params, oracle = setup
+        p1, p2 = [3, 17, 42, 7, 99], [5, 5, 8]
+        want1 = oracle.generate([p1])[0]
+        want2 = oracle.generate([p2])[0]
+        eng = self._engine(cfg, params, 3)
+        eng.admit(1, p1, GREEDY.max_new_tokens)
+        results = {}
+        for rid, toks in eng.step():  # one 3-step window with p1 alone
+            results[rid] = toks
+        eng.admit(2, p2, GREEDY.max_new_tokens)  # joins between windows
+        for _ in range(200):
+            for rid, toks in eng.step():
+                results[rid] = toks
+            if not eng.has_active():
+                break
+        assert results == {1: want1, 2: want2}
+
+    def test_sampled_parity_with_per_step_sync(self, setup):
+        """Seeded sampling: draws are (seed, position)-keyed, so the window
+        size must not change what a request samples."""
+        cfg, params, _ = setup
+        sampling = SamplingConfig(do_sample=True, temperature=0.8, top_p=0.9,
+                                  max_new_tokens=8, seed=7)
+        p = [3, 17, 42, 7, 99]
+        e1 = self._engine(cfg, params, 1, sampling=sampling)
+        _, f1 = e1.admit(1, p, 8, seed=123)
+        assert f1 is None
+        r1 = self._drain_one(e1, 1)
+        e4 = self._engine(cfg, params, 4, sampling=sampling)
+        _, f4 = e4.admit(1, p, 8, seed=123)
+        assert f4 is None
+        r4 = self._drain_one(e4, 1)
+        assert r1 == r4
+
+    @staticmethod
+    def _drain_one(eng, rid):
+        for _ in range(200):
+            for got_rid, toks in eng.step():
+                if got_rid == rid:
+                    return toks
+            if not eng.has_active():
+                break
+        raise AssertionError("request never completed")
+
+    def test_eos_mid_window_freezes_row(self, setup):
+        """A row that samples EOS mid-window must stop there (post-EOS window
+        tokens discarded) while a batchmate keeps decoding — k=1 parity is
+        the oracle. The EOS id is chosen from the greedy stream itself so the
+        hit genuinely lands mid-window."""
+        import dataclasses
+        cfg, params, oracle = setup
+        p1, p2 = [3, 17, 42, 7, 99], [5, 5, 8]
+        stream = oracle.generate([p1])[0]
+        eos_tok = stream[4]  # EOS strikes at the 5th token: mid-window for k=4
+        cfg_eos = dataclasses.replace(cfg, eos_token_ids=(eos_tok,))
+        outs = {}
+        for k in (1, 4):
+            eng = self._engine(cfg_eos, params, k)
+            outs[k] = self._drain(eng, [(1, p1, 8), (2, p2, 8)])
+        assert outs[1] == outs[4]
+        assert len(outs[1][1]) < 8, "EOS never fired — the fixture is vacuous"
+        assert outs[1][1] == stream[:len(outs[1][1])]

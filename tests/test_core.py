@@ -91,6 +91,12 @@ class TestReferenceParityDefaults:
         c = AppConfig.from_env({"TPU_RAG_MESH": "dp=2,tp=4"})
         assert c.mesh.dp == 2 and c.mesh.tp == 4
 
+    def test_from_env_sync_steps(self):
+        c = AppConfig.from_env({"TPU_RAG_SYNC_STEPS": "8"})
+        assert c.engine.decode_sync_steps == 8
+        with pytest.raises(ValueError):
+            AppConfig.from_env({"TPU_RAG_SYNC_STEPS": "0"})
+
 
 class TestMesh:
     def test_resolved_auto_tp(self):
