@@ -18,6 +18,13 @@ What runs:
    queries run embed → kNN → prefill → 150-token sampled decode on-chip
    with the reference's exact generation budget (rag.py:172) and retrieval
    shape (rag.py:39,114,164). Latency is wall-clock at the HTTP client.
+   Measured on the 1B proxy (bf16 + int8) AND on the flagship the reference
+   actually serves — Llama-3.1-8B, int8 weights + int8 KV on the one chip —
+   solo and at concurrency 8, with the tunnel share itemized
+   (``tunnel_fetch_ms`` × the 2 irreducible fetches per query).
+3. Continuous-engine steady state: slot-based serving throughput under a
+   saturating stream at sync windows k=1 and k=16, vs the coalescing
+   scheduler on the same workload (VERDICT r3 #3).
 
 Baseline: the reference serves generation through HF ``transformers``
 ``model.generate`` on CPU (/root/reference/llm/rag.py:172, fp32). The SAME
@@ -125,14 +132,46 @@ def _synthetic_pdf(n_words: int = 4000) -> bytes:
     )
 
 
+_TUNNEL_MS = None
+
+
+def measure_tunnel_fetch_ms() -> float:
+    """Median cost of fetching ONE device scalar that is already computed —
+    pure host↔device link latency (μs on a directly-attached TPU, ~200 ms
+    over this harness's network tunnel). Used to itemize the tunnel's share
+    of every end-to-end latency this bench reports. Measured once per
+    process: every consumer must subtract the SAME sample."""
+    global _TUNNEL_MS
+    if _TUNNEL_MS is not None:
+        return _TUNNEL_MS
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((8, 8), jnp.float32))
+    np.asarray(x)  # settle
+    f = jax.jit(lambda a: (a * 2).sum())
+    np.asarray(f(x))  # compile outside the timed loop
+    costs = []
+    for _ in range(5):
+        y = f(x)
+        t0 = time.monotonic()
+        np.asarray(y)
+        costs.append((time.monotonic() - t0) * 1e3)
+    _TUNNEL_MS = sorted(costs)[len(costs) // 2]
+    return _TUNNEL_MS
+
+
 def measure_query_e2e() -> dict:
     """North-star: end-to-end /query latency through the real WSGI app.
 
-    The headline p50 serves bf16 (numerics-exact). The int8 serving mode
-    (TPU_RAG_WEIGHT_QUANT) is measured through the SAME ingested index and
-    reported as ``query_p50_int8_ms`` — decode dominates the p50 and int8
-    cuts its per-step HBM traffic, so this is the deployment knob for
-    latency-sensitive installs.
+    The headline p50 serves the 1B proxy in bf16 (numerics-exact) plus its
+    int8 serving mode, and — the flagship — **Llama-3.1-8B int8+int8-KV**,
+    the model the reference actually serves (download_model.py:5), at the
+    reference's exact budget (150 new tokens, k=5 → top-3 context,
+    rag.py:114,164,172): batch-1 ``query_p50_8b_ms`` and a concurrency-8
+    amortized figure, with the tunnel's share itemized via
+    ``tunnel_fetch_ms`` (2 irreducible fetches per query).
     """
     import jax
     import jax.numpy as jnp
@@ -149,20 +188,14 @@ def measure_query_e2e() -> dict:
     from rag_llm_k8s_tpu.engine.engine import InferenceEngine
     from rag_llm_k8s_tpu.index.store import VectorStore
     from rag_llm_k8s_tpu.models.bge_m3 import init_encoder_params
-    from rag_llm_k8s_tpu.models.llama import init_llama_params
+    from rag_llm_k8s_tpu.models.llama import init_llama_params, quantize_llama_params
     from rag_llm_k8s_tpu.server.app import RagService, create_app
 
     def zeros_like_tree(shapes):
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
     dtypes = DTypePolicy()
-    llama_cfg = LlamaConfig.llama_3_2_1b()
     enc_cfg = EncoderConfig.bge_m3()
-    app_cfg = AppConfig(model=llama_cfg, encoder=enc_cfg)
-
-    llama_params = zeros_like_tree(
-        jax.eval_shape(lambda: init_llama_params(jax.random.PRNGKey(0), llama_cfg, dtypes))
-    )
     encoder = EncoderRunner(
         enc_cfg,
         zeros_like_tree(
@@ -173,41 +206,58 @@ def measure_query_e2e() -> dict:
         max_batch=8,
     )
     store = VectorStore(dim=enc_cfg.embed_dim)
-    tok = WordHashTokenizer(llama_cfg.vocab_size, bos=llama_cfg.bos_token_id)
     enc_tok = WordHashTokenizer(enc_cfg.vocab_size)
 
-    def run_mode(weight_quant: str, ingest: bool, concurrency: int = 0):
+    def make_params(llama_cfg, weight_quant: str):
+        shapes = jax.eval_shape(
+            lambda: init_llama_params(jax.random.PRNGKey(0), llama_cfg, dtypes)
+        )
+        if weight_quant == "int8":
+            # pre-quantized zeros at true shapes (the 8B bf16 layout would
+            # not fit 16 GB HBM; the production loader quantizes host-side
+            # during the streaming load, models/loader.py)
+            shapes = jax.eval_shape(quantize_llama_params, shapes)
+        return zeros_like_tree(shapes)
+
+    def run_mode(
+        llama_cfg,
+        params,
+        weight_quant: str,
+        ingest: bool,
+        concurrency: int = 0,
+        kv_quant: str = "bf16",
+        n_queries: int = len(QUERIES),
+    ):
+        app_cfg = AppConfig(model=llama_cfg, encoder=enc_cfg)
+        tok = WordHashTokenizer(llama_cfg.vocab_size, bos=llama_cfg.bos_token_id)
         # one 4096 bucket: the reference's full 3×1000-word context (~4k
         # tokens) fits without shrinking, so the measured prefill is the
         # real RAG prompt
         engine = InferenceEngine(
             llama_cfg,
-            llama_params,
+            params,
             sampling=SamplingConfig(),  # reference parity: 150 new, 0.7/0.9
             engine_config=EngineConfig(
                 prompt_buckets=(4096,),
                 max_batch_size=max(4, concurrency),
                 weight_quant=weight_quant,
+                kv_quant=kv_quant,
             ),
             dtypes=dtypes,
         )
         scheduler = None
         if concurrency:
             # under-load mode: concurrent requests coalesce into batched
-            # generate calls (BASELINE config #5). The COALESCING scheduler
-            # is measured rather than the continuous one because the
-            # continuous engine syncs the host once per decode step — μs on
-            # a normally-attached TPU, ~200 ms over this harness's tunnel
-            # (see the environment note above), which would measure the
-            # tunnel, not the batching design.
+            # generate calls (BASELINE config #5) behind the coalesced
+            # embed+kNN stage (RagService.retrieve_coalescer): the fused
+            # retrieval of a concurrent burst runs as ONE padded device
+            # call, so arrivals reach the generate stage together and a
+            # production-sized window coalesces them. (Round 3 serialized
+            # each worker's retrieve fetch on the tunnel and needed a
+            # 1500 ms window to coalesce anything.)
             from rag_llm_k8s_tpu.engine.batching import BatchScheduler
 
-            # the coalescing window must cover the ARRIVAL SPREAD of the
-            # concurrent burst: each request's embed+kNN fetch serializes on
-            # the tunnel (~250 ms apiece here), so 30 ms — a sane production
-            # window — would coalesce nothing in this harness and every
-            # query would decode alone
-            scheduler = BatchScheduler(engine, max_wait_ms=1500.0)
+            scheduler = BatchScheduler(engine, max_wait_ms=100.0)
         service = RagService(
             app_cfg, engine, tok, encoder, enc_tok, store, scheduler=scheduler
         )
@@ -234,12 +284,17 @@ def measure_query_e2e() -> dict:
         client.post("/query", json={"prompt": QUERIES[0]})  # warm end to end
         lat_ms = []
         stages = {"tokenize_ms": [], "embed_retrieve_ms": [], "generate_ms": []}
+        jobs = list(QUERIES)
+        while len(jobs) < n_queries:
+            jobs += QUERIES
+        jobs = jobs[:n_queries]
 
         if concurrency:
             import threading
 
             lock = threading.Lock()
-            jobs = list(QUERIES) + list(QUERIES[: max(0, 2 * concurrency - len(QUERIES))])
+            while len(jobs) < 2 * concurrency:
+                jobs += QUERIES
             errors = []
 
             def worker(queries):
@@ -250,8 +305,11 @@ def measure_query_e2e() -> dict:
                         r = c.post("/query", json={"prompt": q})
                         dt_ms = (time.monotonic() - t0) * 1e3
                         assert r.status_code == 200, r.get_data()
+                        body = r.get_json()
                         with lock:
                             lat_ms.append(dt_ms)
+                            for k in stages:
+                                stages[k].append(body["timings"][k])
                 except BaseException as e:  # noqa: BLE001 — re-raised below
                     with lock:
                         errors.append(e)
@@ -270,11 +328,11 @@ def measure_query_e2e() -> dict:
                 # a swallowed worker failure would leave qps computed over
                 # jobs that never ran — fail the bench loudly instead
                 raise errors[0]
-            scheduler.shutdown()
+            service.shutdown()
             lat_ms.sort()
-            return lat_ms, {"qps": len(jobs) / wall_s, "n": len(jobs)}, None
+            return lat_ms, {"qps": len(jobs) / wall_s, "n": len(jobs), "stages": stages}, None
 
-        for q in QUERIES:
+        for q in jobs:
             t0 = time.monotonic()
             r = client.post("/query", json={"prompt": q})
             lat_ms.append((time.monotonic() - t0) * 1e3)
@@ -285,9 +343,31 @@ def measure_query_e2e() -> dict:
         lat_ms.sort()
         return lat_ms, stages, ingest_s
 
-    lat_ms, stages, ingest_s = run_mode("bf16", ingest=True)
-    lat_int8, _, _ = run_mode("int8", ingest=False)  # same index, same queries
-    lat_load, load_info, _ = run_mode("bf16", ingest=False, concurrency=8)
+    def stage_means(stages) -> dict:
+        return {
+            k.removesuffix("_ms"): round(sum(v) / len(v), 1) for k, v in stages.items()
+        }
+
+    cfg_1b = LlamaConfig.llama_3_2_1b()
+    params_1b = make_params(cfg_1b, "bf16")
+    lat_ms, stages, ingest_s = run_mode(cfg_1b, params_1b, "bf16", ingest=True)
+    params_1b_q = make_params(cfg_1b, "int8")
+    lat_int8, _, _ = run_mode(cfg_1b, params_1b_q, "int8", ingest=False)
+    lat_load, load_info, _ = run_mode(
+        cfg_1b, params_1b, "bf16", ingest=False, concurrency=8
+    )
+    del params_1b, params_1b_q
+
+    # ---- flagship: Llama-3.1-8B int8 weights + int8 KV, same WSGI path ----
+    cfg_8b = LlamaConfig.llama_3_1_8b()
+    params_8b = make_params(cfg_8b, "int8")
+    lat_8b, stages_8b, _ = run_mode(
+        cfg_8b, params_8b, "int8", ingest=False, kv_quant="int8", n_queries=12
+    )
+    lat_8b_load, load_8b, _ = run_mode(
+        cfg_8b, params_8b, "int8", ingest=False, kv_quant="int8", concurrency=8
+    )
+    del params_8b
     # BASELINE config #2 (batch embedding): warm chunks/s through the
     # bucketed encoder, compile and PDF parsing excluded — the reference
     # embeds ONE chunk per SentenceTransformer.encode call (rag.py:55,101).
@@ -302,6 +382,11 @@ def measure_query_e2e() -> dict:
     encoder.encode(token_lists)
     ingest_rate = len(chunks) / (time.monotonic() - t0)
     n = len(lat_ms)
+    tunnel_ms = measure_tunnel_fetch_ms()
+    # 2 irreducible device→host fetches per query (retrieved ids → prompt
+    # text, then the output tokens): that is the tunnel's per-query share, a
+    # directly-attached TPU serves the same fetches in microseconds
+    adj = 2 * tunnel_ms
     return {
         "query_p50_ms": round(lat_ms[n // 2], 1),
         "query_p95_ms": round(lat_ms[max(0, math.ceil(n * 0.95) - 1)], 1),
@@ -311,11 +396,25 @@ def measure_query_e2e() -> dict:
         # (rag.py:204), so its qps is 1 / its per-query latency
         "query_qps_load": round(load_info["qps"], 2),
         "query_p50_load_ms": round(lat_load[len(lat_load) // 2], 1),
+        "query_p50_load_adj_ms": round(lat_load[len(lat_load) // 2] - adj, 1),
+        "query_load_stage_ms": stage_means(load_info["stages"]),
         "query_load_concurrency": 8,
-        "query_stage_ms": {
-            k.removesuffix("_ms"): round(sum(v) / len(v), 1) for k, v in stages.items()
-        },
+        "query_stage_ms": stage_means(stages),
         "query_n": n,
+        # ---- flagship: the model the reference serves (8B), int8 w+kv ----
+        "query_p50_8b_ms": round(lat_8b[len(lat_8b) // 2], 1),
+        "query_p95_8b_ms": round(
+            lat_8b[max(0, math.ceil(len(lat_8b) * 0.95) - 1)], 1
+        ),
+        "query_p50_8b_adj_ms": round(lat_8b[len(lat_8b) // 2] - adj, 1),
+        "query_8b_stage_ms": stage_means(stages_8b),
+        "query_qps_8b_load": round(load_8b["qps"], 2),
+        "query_p50_8b_load_ms": round(lat_8b_load[len(lat_8b_load) // 2], 1),
+        # amortized per-query cost under load: what one more concurrent user
+        # actually pays on a saturated chip
+        "query_8b_load_amortized_ms": round(1e3 / load_8b["qps"], 1),
+        "query_8b_load_stage_ms": stage_means(load_8b["stages"]),
+        "tunnel_fetch_ms": round(tunnel_ms, 1),
         "ingest_s": round(ingest_s, 1),
         "ingest_warm_chunks_per_s": round(ingest_rate, 1),
         "index_vectors": store.ntotal,
@@ -456,6 +555,140 @@ def measure_8b_int8() -> dict:
     return {"llama_8b_int8_tok_per_s": round(best, 1), "llama_8b_int8_batch": batch}
 
 
+def measure_knn_scale() -> dict:
+    """Retrieval at corpus scale: fused distance+top-k ms/query at N=100k
+    and N=1M vectors (bge-m3 dim 1024, fp32 — 4.1 GB resident at 1M), vs
+    the XLA oracle at 1M. Data is generated ON DEVICE (no host transfer);
+    timing dispatches M searches and fetches once, subtracting the single
+    link round-trip, so the figure is device time, not tunnel time.
+    (Parity bar: faiss IndexFlatL2 — rag.py:61 — at this scale on CPU.)"""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rag_llm_k8s_tpu.ops.knn import knn_topk_pallas, knn_topk_xla
+
+    D, K, M = 1024, 5, 20
+    rtt_ms = measure_tunnel_fetch_ms()
+    out = {}
+    q = jax.random.normal(jax.random.PRNGKey(1), (1, D), jnp.float32)
+    for N, label in ((100_352, "100k"), (1_000_448, "1m")):  # 512-multiples
+        emb = jax.random.normal(jax.random.PRNGKey(0), (N, D), jnp.float32)
+        norms = jnp.sum(emb * emb, axis=1)[None, :]
+        for name, fn in (("knn", knn_topk_pallas), ("knn_xla", knn_topk_xla)):
+            if name == "knn_xla" and label != "1m":
+                continue  # oracle comparison once, at the big size
+            np.asarray(fn(q, emb, norms, k=K)[0])  # compile + settle
+            best = float("inf")
+            for _ in range(3):  # best-of-3: the shared link adds variance
+                t0 = time.monotonic()
+                for _ in range(M):
+                    d, i = fn(q, emb, norms, k=K)
+                np.asarray(d)
+                best = min(best, ((time.monotonic() - t0) * 1e3 - rtt_ms) / M)
+            out[f"{name}_ms_{label}"] = round(max(best, 0.0), 2)
+        del emb, norms
+    out["knn_dim"] = D
+    return out
+
+
+def measure_continuous() -> dict:
+    """Steady-state throughput of the slot-based continuous engine under a
+    saturating request stream (8 concurrent submitters, 24 requests), vs the
+    coalescing scheduler on the SAME workload. Reported per sync window
+    (``decode_sync_steps``): k=1 is the admit-every-token design point; k=16
+    amortizes the per-window host sync — ~μs on a directly-attached TPU,
+    ~200 ms over this harness's tunnel (the 'tunnel_fetch_ms' field), which
+    is also why the continuous engine additionally pays one tunneled fetch
+    per ADMISSION (the first sampled token returns to the host there).
+    """
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from rag_llm_k8s_tpu.core.config import (
+        DTypePolicy,
+        EngineConfig,
+        LlamaConfig,
+        SamplingConfig,
+    )
+    from rag_llm_k8s_tpu.engine.batching import BatchScheduler
+    from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine, ContinuousScheduler
+    from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+    from rag_llm_k8s_tpu.models.llama import init_llama_params
+
+    config = LlamaConfig.llama_3_2_1b()
+    dtypes = DTypePolicy()
+    shapes = jax.eval_shape(lambda: init_llama_params(jax.random.PRNGKey(0), config, dtypes))
+    params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    B, NREQ, CONCURRENCY = 8, 24, 8
+    sampling = SamplingConfig(do_sample=False, max_new_tokens=NEW_TOKENS)
+    prompts = [[config.bos_token_id] * PROMPT_LEN for _ in range(NREQ)]
+
+    def drive(scheduler) -> float:
+        """8 threads push 24 requests through a scheduler; returns wall s."""
+        errors, lock = [], threading.Lock()
+        done_tokens = [0]
+
+        def worker(jobs):
+            try:
+                for p in jobs:
+                    out = scheduler.submit(p, timeout=600)
+                    with lock:
+                        done_tokens[0] += len(out)
+            except BaseException as e:  # noqa: BLE001
+                with lock:
+                    errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(prompts[i::CONCURRENCY],))
+            for i in range(CONCURRENCY)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        if errors:
+            raise errors[0]
+        assert done_tokens[0] == NREQ * NEW_TOKENS, done_tokens
+        return wall
+
+    out = {}
+    for sync in (1, 16):
+        eng = ContinuousEngine(
+            config, params, sampling=sampling,
+            engine_config=EngineConfig(
+                prompt_buckets=(PROMPT_LEN,), max_batch_size=B,
+                max_seq_len=PROMPT_LEN + NEW_TOKENS + 8, decode_sync_steps=sync,
+            ),
+            dtypes=dtypes,
+        )
+        eng.warmup()
+        sched = ContinuousScheduler(eng)
+        sched.submit(prompts[0], timeout=600)  # end-to-end warm
+        steps0 = eng.steps
+        wall = drive(sched)
+        sched.shutdown()
+        out[f"continuous_tok_per_s_sync{sync}"] = round(NREQ * NEW_TOKENS / wall, 1)
+        out[f"continuous_steps_per_s_sync{sync}"] = round((eng.steps - steps0) / wall, 1)
+
+    engine = InferenceEngine(
+        config, params, sampling=sampling,
+        engine_config=EngineConfig(prompt_buckets=(PROMPT_LEN,), max_batch_size=B),
+        dtypes=dtypes,
+    )
+    engine.warmup(batch_sizes=(B,), buckets=(PROMPT_LEN,))
+    sched = BatchScheduler(engine, max_wait_ms=100.0)
+    sched.submit(prompts[0], timeout=600)
+    wall = drive(sched)
+    sched.shutdown()
+    out["coalesce_tok_per_s"] = round(NREQ * NEW_TOKENS / wall, 1)
+    return out
+
+
 def measure_cpu_baseline() -> float:
     """Reference stack (torch fp32 transformers.generate) on the same arch."""
     import torch
@@ -517,6 +750,8 @@ def main():
     tpu = measure_tpu()
     b8 = measure_8b_int8()
     lc = measure_longctx()
+    knn = measure_knn_scale()
+    cont = measure_continuous()
     e2e = measure_query_e2e()
     line = {
         "metric": "llama_1b_decode_throughput",
@@ -530,6 +765,8 @@ def main():
     }
     line.update(b8)
     line.update(lc)
+    line.update(knn)
+    line.update(cont)
     line.update(e2e)
     print(json.dumps(line))
 

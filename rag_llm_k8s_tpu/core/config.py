@@ -278,10 +278,17 @@ class EngineConfig:
     # bucket-sized chunks (chunk_prefill_attention) up to this many tokens;
     # beyond it the engine truncates LOUDLY (logged), never silently
     max_chunked_prompt: int = 16384
-    # request scheduling: "continuous" = slot-based decode, requests join
-    # the running batch between steps (engine/continuous.py); "coalesce" =
-    # group compatible requests at start only (engine/batching.py)
-    batching: str = "continuous"
+    # request scheduling: "coalesce" = group compatible requests at start
+    # (engine/batching.py) — the default: its one device program per batch
+    # measured 1726 tok/s vs the continuous engine's 232 on the round-4
+    # steady-state bench (BENCH_r04, saturating stream, same 1B model,
+    # concurrency 8), because slot-based serving pays a host sync per
+    # admission and per decode window. "continuous" = slot-based decode,
+    # requests join the running batch between steps (engine/continuous.py)
+    # — pick it on DIRECTLY-ATTACHED hosts (sync cost ~μs, not the
+    # tunnel's ~130-200 ms) when streaming arrivals make time-to-first-
+    # token matter more than peak throughput; tune decode_sync_steps.
+    batching: str = "coalesce"
     # attention backend: "auto" = fused Pallas kernels on TPU, XLA einsum
     # oracle elsewhere (see models.llama.Attention)
     attn_impl: str = "auto"

@@ -39,12 +39,13 @@ from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
 from rag_llm_k8s_tpu.ops.attention import (
     attention_xla,
     chunk_attention_xla,
+    chunk_attention_xla_q8,
     chunk_prefill_attention,
+    chunk_prefill_attention_q8,
     decode_attention,
     decode_attention_q8,
     decode_attention_xla,
     decode_attention_xla_q8,
-    dequantize_layer_slice,
     flash_attention,
     quantize_kv,
 )
@@ -287,25 +288,14 @@ class Attention(nn.Module):
           (offset causality over the populated prefix).
 
         ``scales`` (int8-KV only): ``(k_scale, v_scale) [L, B, K, T]`` fp32
-        riding alongside an int8 cache. Decode streams them through the q8
-        kernel; chunk dequantizes THIS layer's slice to bf16 (a layer slice,
-        never the stacked cache) and reuses the bf16 chunk kernel.
+        riding alongside an int8 cache. Decode and chunk both stream them
+        through their q8 kernels (dequantization rides the matmul epilogues
+        — no bf16 layer slice is ever materialized; the XLA oracle path
+        dequantizes a slice, but it is the oracle, not the serving path).
         """
         impl = self._resolved_impl()
         mesh = self.mesh
         cache_kv = mode in ("decode", "chunk")
-        if scales is not None and mode == "chunk":
-            # dequantized [1, B, K, T, hd] view of this layer only (shared
-            # helper with the q8 oracle), then the bf16 chunk kernel runs
-            # unchanged at layer 0 of the one-layer view
-            k = dequantize_layer_slice(
-                k, scales[0], layer, kv_start, kv_len, self.dtypes.compute_dtype
-            )
-            v = dequantize_layer_slice(
-                v, scales[1], layer, kv_start, kv_len, self.dtypes.compute_dtype
-            )
-            layer = jnp.int32(0)
-            scales = None
         # kv heads sit at dim 2 in both layouts ([L,B,K,T,hd] / [B,S,K,hd])
         H, K = q.shape[2], k.shape[2]
         tp = (
@@ -339,6 +329,11 @@ class Attention(nn.Module):
                     )
                 return decode_attention_xla(q, k, v, kv_start, kv_len, layer)
             if mode == "chunk":
+                if scales is not None:
+                    return chunk_attention_xla_q8(
+                        q, k, v, scales[0], scales[1], kv_start, kv_len,
+                        layer, write_index,
+                    )
                 return chunk_attention_xla(
                     q, k, v, kv_start, kv_len, layer, write_index
                 )
@@ -352,6 +347,10 @@ class Attention(nn.Module):
         elif mode == "decode":
             kernel = lambda q_, k_, v_, s_, l_, lay_: decode_attention(  # noqa: E731
                 q_, k_, v_, s_, l_, lay_, interpret=interpret
+            )
+        elif mode == "chunk" and scales is not None:
+            kernel = lambda q_, k_, v_, ks_, vs_, s_, l_, lay_, wi_: chunk_prefill_attention_q8(  # noqa: E731
+                q_, k_, v_, ks_, vs_, s_, l_, lay_, wi_, interpret=interpret
             )
         elif mode == "chunk":
             kernel = lambda q_, k_, v_, s_, l_, lay_, wi_: chunk_prefill_attention(  # noqa: E731
@@ -393,11 +392,11 @@ class Attention(nn.Module):
                 return kernel(q, k, v, scales[0], scales[1], kv_start, kv_len, lay1)
             return kernel(q, k, v, kv_start, kv_len, lay1)
         if mode == "chunk":
-            return kernel(
-                q, k, v, kv_start, kv_len,
-                jnp.asarray(layer, jnp.int32).reshape(1),
-                jnp.asarray(write_index, jnp.int32).reshape(1),
-            )
+            lay1 = jnp.asarray(layer, jnp.int32).reshape(1)
+            wi1 = jnp.asarray(write_index, jnp.int32).reshape(1)
+            if scales is not None:
+                return kernel(q, k, v, scales[0], scales[1], kv_start, kv_len, lay1, wi1)
+            return kernel(q, k, v, kv_start, kv_len, lay1, wi1)
         return kernel(q, k, v, kv_start, kv_len)
 
     def _attend_ring(self, q, k, v, kv_start, kv_len, sp: int, tp: int) -> jax.Array:

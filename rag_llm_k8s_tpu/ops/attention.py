@@ -803,6 +803,189 @@ def decode_attention_q8(
     return out.reshape(B, 1, H, hd)
 
 
+def _chunk_kernel_q8(
+    layer_ref,  # SMEM [1] (consumed by the index maps)
+    wi_ref,  # SMEM [1]: write_index — global cache slot of query 0
+    kv_start_ref,  # SMEM [B]
+    kv_len_ref,  # SMEM [B]
+    q_ref,  # [1, bq, hd]
+    k_ref,  # [1, 1, 1, bk, hd] int8
+    v_ref,  # [1, 1, 1, bk, hd] int8
+    ks_ref,  # [1, 1, K, bk] fp32 — ALL kv heads' scales for this block range
+    vs_ref,  # [1, 1, K, bk] fp32
+    o_ref,  # [1, bq, hd]
+    m_scr,  # VMEM [bq, 1]
+    l_scr,  # VMEM [bq, 1]
+    acc_scr,  # VMEM [bq, hd]
+    *,
+    bq: int,
+    bk: int,
+    scale: float,
+    num_heads: int,
+    group: int,
+):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+    b = bh // num_heads
+    # Mosaic's tile rules reject a (1, bk) scale block ((1, 1, 1, bk) spec:
+    # second-to-minor 1 neither divides 8 nor equals K), so the block carries
+    # all K heads' scales — KBs — and the kernel row-selects its own kv head
+    # with an iota mask (a [K, bk] VPU reduce, nothing on the payload path)
+    kvh = (bh % num_heads) // group
+    wi = wi_ref[0]
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_hi = wi + qi * bq + bq - 1  # last query slot of this q block
+    overlap = (kj * bk + bk > kv_start_ref[b]) & (kj * bk < kv_len_ref[b])
+    live = overlap & (kj * bk <= q_hi)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        # int8 payloads need NO validity masking (every bit pattern is
+        # finite); invalid columns die via the score mask + zeroed scales —
+        # dequantization rides the epilogues exactly as in _decode_kernel_q8
+        k = k_ref[0, 0, 0].astype(q.dtype)  # [bk, hd]
+        rows = jax.lax.broadcasted_iota(jnp.int32, ks_ref.shape[2:], 0)  # [K, bk]
+        ks_row = jnp.sum(jnp.where(rows == kvh, ks_ref[0, 0], 0.0), axis=0)
+        vs_row = jnp.sum(jnp.where(rows == kvh, vs_ref[0, 0], 0.0), axis=0)
+        cpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        cok = (cpos >= kv_start_ref[b]) & (cpos < kv_len_ref[b])
+        # scales CAN be NaN past the frontier (uninitialized fp32 memory)
+        ks = jnp.where(cok, ks_row[None, :], 0.0)  # [1, bk]
+        vs = jnp.where(cok, vs_row[None, :], 0.0)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale * ks  # [bq, bk]; ks broadcasts over the bq rows
+
+        q_pos = wi + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        ok = (k_pos >= kv_start_ref[b]) & (k_pos < kv_len_ref[b]) & (k_pos <= q_pos)
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(ok, jnp.exp(s - m_new), 0.0)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = (p * vs).astype(q.dtype)  # V scale folded into the prob matrix
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            pv, v_ref[0, 0, 0].astype(q.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bq", "bk", "interpret"))
+def chunk_prefill_attention_q8(
+    q: jax.Array,  # [B, S, H, hd] — one prompt chunk's fresh queries
+    k_cache: jax.Array,  # [L, B, K, T, hd] int8
+    v_cache: jax.Array,  # [L, B, K, T, hd] int8
+    k_scale: jax.Array,  # [L, B, K, T] fp32
+    v_scale: jax.Array,  # [L, B, K, T] fp32
+    kv_start: jax.Array,  # [B] int32
+    kv_len: jax.Array,  # [B] int32
+    layer: jax.Array,  # [] or [1] int32
+    write_index: jax.Array,  # [] or [1] int32: cache slot of query 0
+    bq: int = 512,
+    bk: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """``chunk_prefill_attention`` over an int8 KV cache: offset-causal
+    flash attention where each query block streams the int8 cache blocks
+    directly and dequantizes in the matmul EPILOGUES (score × k-scale,
+    prob × v-scale) — the long-prompt int8 path never materializes a bf16
+    layer slice, so chunked prefill keeps the bandwidth int8 bought.
+    (Round 3 dequantized ``[1, B, K, T, hd]`` bf16 per layer per chunk.)"""
+    B, S, H, hd = q.shape
+    L, _, K, T, _ = k_cache.shape
+    G = H // K
+    bq = _fit_block(S, bq)
+    bk = _decode_block(T, bk)
+    if not interpret and bk % 32:
+        # int8 blocks need a 32-row second-to-minor tile on real hardware
+        raise ValueError(
+            f"cache length T={T} only tiles into blocks of {bk}: pad T to a "
+            "multiple of 128 — the engine rounds cache lengths for this"
+        )
+
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, S, hd)
+    grid = (B * H, S // bq, T // bk)
+
+    def kv_index(bh, qi, kj, layer_ref, *s_):
+        return (layer_ref[0], bh // H, (bh % H) // G, kj, 0)
+
+    def sc_index(bh, qi, kj, layer_ref, *s_):
+        return (layer_ref[0], bh // H, 0, kj)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _chunk_kernel_q8, bq=bq, bk=bk, scale=hd**-0.5, num_heads=H, group=G
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, hd), lambda bh, qi, kj, *s_: (bh, qi, 0)),
+                pl.BlockSpec((1, 1, 1, bk, hd), kv_index),
+                pl.BlockSpec((1, 1, 1, bk, hd), kv_index),
+                pl.BlockSpec((1, 1, K, bk), sc_index),
+                pl.BlockSpec((1, 1, K, bk), sc_index),
+            ],
+            out_specs=pl.BlockSpec((1, bq, hd), lambda bh, qi, kj, *s_: (bh, qi, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, hd), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        jnp.asarray(write_index, jnp.int32).reshape(1),
+        kv_start.astype(jnp.int32),
+        kv_len.astype(jnp.int32),
+        qt,
+        k_cache,
+        v_cache,
+        k_scale,
+        v_scale,
+    )
+
+    return out.reshape(B, H, S, hd).transpose(0, 2, 1, 3)
+
+
+def chunk_attention_xla_q8(
+    q: jax.Array,  # [B, S, H, hd]
+    k_cache: jax.Array,  # [L, B, K, T, hd] int8
+    v_cache: jax.Array,  # [L, B, K, T, hd] int8
+    k_scale: jax.Array,  # [L, B, K, T] fp32
+    v_scale: jax.Array,  # [L, B, K, T] fp32
+    kv_start: jax.Array,  # [B]
+    kv_len: jax.Array,  # [B]
+    layer: jax.Array,  # [] or [1] int32
+    write_index: jax.Array,  # [] int32
+) -> jax.Array:
+    """Dense XLA reference for ``chunk_prefill_attention_q8`` (oracle; CPU
+    path). Dequantizes THIS layer's cache slice and reuses the bf16 oracle."""
+    kd = dequantize_layer_slice(k_cache, k_scale, layer, kv_start, kv_len, q.dtype)
+    vd = dequantize_layer_slice(v_cache, v_scale, layer, kv_start, kv_len, q.dtype)
+    return chunk_attention_xla(q, kd, vd, kv_start, kv_len, jnp.int32(0), write_index)
+
+
 def decode_attention_xla_q8(
     q: jax.Array,  # [B, 1, H, hd]
     k_cache: jax.Array,  # [L, B, K, T, hd] int8

@@ -346,3 +346,93 @@ class TestChunkPrefillAttention:
         want = flash_attention(q, fresh_k, fresh_v, kv_start, kv_len,
                                causal=True, bq=64, bk=64, interpret=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+
+class TestChunkPrefillAttentionQ8:
+    """int8-KV chunked-prefill kernel (interpret mode) vs its q8 oracle and
+    vs the bf16 cache path — the long-prompt int8 serving path must never
+    materialize a bf16 layer slice, so the kernel dequantizes in epilogues."""
+
+    def _problem(self, seed, B=2, S=64, H=8, K=2, T=256, hd=64, L=3):
+        from rag_llm_k8s_tpu.ops.attention import quantize_kv
+
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (B, S, H, hd), jnp.float32)
+        k_cache = jax.random.normal(ks[1], (L, B, K, T, hd), jnp.float32)
+        v_cache = jax.random.normal(ks[2], (L, B, K, T, hd), jnp.float32)
+        kq, kscale = quantize_kv(k_cache)
+        vq, vscale = quantize_kv(v_cache)
+        return q, k_cache, v_cache, kq, kscale, vq, vscale
+
+    def test_matches_q8_oracle_per_layer_and_offset(self):
+        from rag_llm_k8s_tpu.ops.attention import (
+            chunk_attention_xla_q8,
+            chunk_prefill_attention_q8,
+        )
+
+        q, _, _, kq, kscale, vq, vscale = self._problem(0)
+        S, T = q.shape[1], kq.shape[3]
+        kv_start = jnp.array([0, 23], jnp.int32)
+        for wi in (0, 64, T - S):  # first chunk, interior chunk, last chunk
+            kv_len = jnp.full((2,), wi + S, jnp.int32)
+            for lay in range(kq.shape[0]):
+                got = chunk_prefill_attention_q8(
+                    q, kq, vq, kscale, vscale, kv_start, kv_len,
+                    jnp.int32(lay), jnp.int32(wi), bq=32, bk=64, interpret=True,
+                )
+                want = chunk_attention_xla_q8(
+                    q, kq, vq, kscale, vscale, kv_start, kv_len,
+                    jnp.int32(lay), jnp.int32(wi),
+                )
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+                )
+
+    def test_q8_close_to_bf16_chunk_path(self):
+        from rag_llm_k8s_tpu.ops.attention import (
+            chunk_attention_xla,
+            chunk_prefill_attention_q8,
+        )
+
+        q, kc, vc, kq, kscale, vq, vscale = self._problem(1)
+        S, T = q.shape[1], kc.shape[3]
+        wi, lay = 64, jnp.int32(1)
+        kv_start = jnp.array([3, 0], jnp.int32)
+        kv_len = jnp.full((2,), wi + S, jnp.int32)
+        got = chunk_prefill_attention_q8(
+            q, kq, vq, kscale, vscale, kv_start, kv_len, lay, jnp.int32(wi),
+            bq=32, bk=64, interpret=True,
+        )
+        want = chunk_attention_xla(q, kc, vc, kv_start, kv_len, lay, jnp.int32(wi))
+        err = float(jnp.linalg.norm(got - want) / (jnp.linalg.norm(want) + 1e-9))
+        assert err < 0.02, f"relative error vs bf16 cache: {err}"
+
+    def test_uninitialized_scale_slots_do_not_poison(self):
+        """Slots past the frontier can hold NaN scales (donated device
+        memory): the window mask must zero them before they touch the
+        accumulator."""
+        from rag_llm_k8s_tpu.ops.attention import (
+            chunk_attention_xla_q8,
+            chunk_prefill_attention_q8,
+        )
+
+        q, _, _, kq, kscale, vq, vscale = self._problem(2)
+        S, T = q.shape[1], kq.shape[3]
+        wi = 64
+        kv_len = jnp.full((2,), wi + S, jnp.int32)
+        kv_start = jnp.zeros((2,), jnp.int32)
+        nan_tail = jnp.where(jnp.arange(T)[None, None, None, :] >= wi + S,
+                             jnp.nan, 1.0)
+        kscale = kscale * nan_tail
+        vscale = vscale * nan_tail
+        got = chunk_prefill_attention_q8(
+            q, kq, vq, kscale, vscale, kv_start, kv_len, jnp.int32(0),
+            jnp.int32(wi), bq=32, bk=64, interpret=True,
+        )
+        assert not bool(jnp.any(jnp.isnan(got))), "NaN scales leaked"
+        want = chunk_attention_xla_q8(
+            q, kq, vq, kscale, vscale, kv_start, kv_len, jnp.int32(0), jnp.int32(wi)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )

@@ -275,3 +275,45 @@ class TestIncrementalDeviceIndex:
         assert res[0].metadata["text"] == "b10"
         assert store.transfer_stats["full_uploads"] == 2
         assert store.transfer_stats["row_update_batches"] == 0
+
+
+class TestCorpusScale:
+    """Retrieval at corpus scale (VERDICT r3 #5): ingest to N >= 100k in
+    batches, assert transfers stay O(batch) with O(log N) full uploads, and
+    ranking stays exact vs the numpy oracle. (faiss IndexFlatL2 — rag.py:61 —
+    shrugs at this scale; the device index must too.)"""
+
+    def test_100k_ingest_bucket_growth_and_exactness(self):
+        rng = np.random.RandomState(11)
+        D, BATCH, NBATCH = 16, 4096, 25  # 102_400 vectors
+        store = VectorStore(dim=D)
+        _ = store.search(np.zeros(D, np.float32), k=1)  # materialize early
+        for b in range(NBATCH):
+            vb = rng.randn(BATCH, D).astype(np.float32)
+            store.add(vb, [{"text": f"b{b}_{i}"} for i in range(BATCH)])
+            # touch the snapshot each batch (as serving does between ingests)
+            store.device_snapshot()
+        N = store.ntotal
+        assert N == BATCH * NBATCH
+        # transfers: one row-update per in-bucket batch; a full re-upload only
+        # on the O(log N) bucket growths (512 -> 131072 is 8 doublings; +1
+        # initial + 1 final-bucket rebuild tolerance)
+        growths = int(np.log2(131072 // 512))
+        stats = store.transfer_stats
+        assert stats["row_update_batches"] + stats["full_uploads"] <= NBATCH + growths + 2
+        assert stats["full_uploads"] <= growths + 2
+        assert stats["row_update_batches"] >= NBATCH - growths - 1
+
+        # exactness at scale: top-5 matches brute-force numpy on 3 queries
+        V = np.asarray(store._vectors)
+        for qi in (0, 7, 31):
+            q = V[qi * 100] + rng.randn(D).astype(np.float32) * 0.01
+            got = store.search(q, k=5)
+            d = ((V - q[None, :]) ** 2).sum(axis=1)
+            want = np.argsort(d, kind="stable")[:5]
+            assert [r.metadata["text"] for r in got] == [
+                store._metadata[int(i)]["text"] for i in want
+            ]
+            np.testing.assert_allclose(
+                [r.distance for r in got], d[want], rtol=1e-4, atol=1e-4
+            )
