@@ -309,6 +309,11 @@ class EngineConfig:
     # of up to k-1 wasted row-steps after a row finishes mid-window and up
     # to k steps of admission latency for a waiting request.
     decode_sync_steps: int = 1
+    # warm every (batch, bucket) executable pair at startup instead of only
+    # the largest bucket's batch ladder — for deployments expecting
+    # concurrent bursts of short, context-free prompts (readiness arrives
+    # later: one compile per pair). Env: TPU_RAG_WARM_FULL_LADDER=1.
+    warm_full_ladder: bool = False
     # KV-cache storage: "bf16" (exact) or "int8" (one fp32 scale per
     # (token, kv-head) vector — halves the cache bytes every decode step
     # scans AND the cache HBM footprint; with a 4096-token prompt bucket the
@@ -419,6 +424,13 @@ class AppConfig:
                     f"TPU_RAG_KV_QUANT={kvq!r}: expected 'bf16' or 'int8'"
                 )
             engine = dataclasses.replace(engine, kv_quant=kvq)
+        if "TPU_RAG_WARM_FULL_LADDER" in env:
+            flag = env["TPU_RAG_WARM_FULL_LADDER"]
+            if flag not in ("0", "1"):
+                raise ValueError(
+                    f"TPU_RAG_WARM_FULL_LADDER={flag!r}: expected '0' or '1'"
+                )
+            engine = dataclasses.replace(engine, warm_full_ladder=flag == "1")
         if "TPU_RAG_SYNC_STEPS" in env:
             k = int(env["TPU_RAG_SYNC_STEPS"])
             if k < 1:

@@ -380,11 +380,9 @@ class RagService:
                 # and land in the LARGEST bucket, so by default only that
                 # bucket's batch ladder is warmed — a concurrent burst of
                 # short, context-free prompts still pays a per-(batch,bucket)
-                # compile mid-request. Deployments that expect such bursts set
-                # TPU_RAG_WARM_FULL_LADDER=1 to warm every (batch, bucket)
-                # pair at startup instead (readiness arrives later: one
-                # compile per pair).
-                if os.environ.get("TPU_RAG_WARM_FULL_LADDER") == "1":
+                # compile mid-request. EngineConfig.warm_full_ladder (env
+                # TPU_RAG_WARM_FULL_LADDER=1) warms every pair instead.
+                if ec.warm_full_ladder:
                     warm_buckets = tuple(ec.prompt_buckets)
                 else:
                     warm_buckets = (max(ec.prompt_buckets),)

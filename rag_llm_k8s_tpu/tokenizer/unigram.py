@@ -88,6 +88,13 @@ class UnigramTokenizer:
         # HF extracts special-token strings from raw text BEFORE
         # normalization/pre-tokenization (AddedVocabulary)
         self._special_re = compile_special_re(self.special_tokens)
+        # SentencePiece's unk scoring rule (kUnkPenalty, mirrored by the HF
+        # Rust Unigram's unk_score_penalty=10): the unk fallback scores 10
+        # below the WORST in-vocab piece, derived from the spec instead of a
+        # hardcoded constant — OOV-heavy multilingual text segments the same
+        # way the Rust engine does regardless of the vocab's score range
+        scores = [s for _, s in pieces]
+        self.unk_score = (min(scores) if scores else 0.0) - 10.0
         self._root = _Trie()
         for i, (piece, score) in enumerate(pieces):
             node = self._root
@@ -107,7 +114,7 @@ class UnigramTokenizer:
         best = [NEG] * (n + 1)
         back: List[Tuple[int, Optional[int]]] = [(-1, None)] * (n + 1)
         best[0] = 0.0
-        unk_penalty = -20.0
+        unk_penalty = self.unk_score
         for i in range(n):
             if best[i] == NEG:
                 continue

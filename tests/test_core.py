@@ -91,6 +91,13 @@ class TestReferenceParityDefaults:
         c = AppConfig.from_env({"TPU_RAG_MESH": "dp=2,tp=4"})
         assert c.mesh.dp == 2 and c.mesh.tp == 4
 
+    def test_from_env_warm_full_ladder(self):
+        c = AppConfig.from_env({"TPU_RAG_WARM_FULL_LADDER": "1"})
+        assert c.engine.warm_full_ladder is True
+        assert AppConfig.from_env({}).engine.warm_full_ladder is False
+        with pytest.raises(ValueError):
+            AppConfig.from_env({"TPU_RAG_WARM_FULL_LADDER": "true"})
+
     def test_from_env_sync_steps(self):
         c = AppConfig.from_env({"TPU_RAG_SYNC_STEPS": "8"})
         assert c.engine.decode_sync_steps == 8
