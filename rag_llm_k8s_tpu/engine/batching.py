@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
@@ -92,9 +93,16 @@ class Coalescer:
                 if first is None:
                     continue
                 batch = [first]
+                # absolute deadline: the window bounds the FIRST item's wait;
+                # a per-get timeout would reset on every arrival and stretch
+                # the worst case to (max_batch-1) x window under trickle load
+                deadline = time.monotonic() + self.max_wait_ms / 1e3
                 while len(batch) < self.max_batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
                     try:
-                        nxt = self._queue.get(timeout=self.max_wait_ms / 1e3)
+                        nxt = self._queue.get(timeout=remaining)
                     except queue.Empty:
                         break
                     if nxt is None:
@@ -210,11 +218,16 @@ class BatchScheduler:
                 continue
             batch = [first]
             cap = self.engine.engine_config.max_batch_size
-            # drain compatible requests within the coalescing window
-            deadline = self.max_wait_ms / 1e3
+            # drain compatible requests within the coalescing window — an
+            # ABSOLUTE deadline (a per-get timeout resets on every arrival:
+            # worst case (cap-1) x window under trickle load)
+            deadline = time.monotonic() + self.max_wait_ms / 1e3
             while len(batch) < cap:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
                 try:
-                    nxt = self._queue.get(timeout=deadline)
+                    nxt = self._queue.get(timeout=remaining)
                 except queue.Empty:
                     break
                 if nxt is None:

@@ -148,7 +148,10 @@ def build_service():
     else:
         from rag_llm_k8s_tpu.engine.batching import BatchScheduler
 
-        scheduler = BatchScheduler(engine)
+        # 30 ms: long enough to catch a cold burst fanning out of ONE
+        # coalesced retrieval (results arrive within ~ms of each other),
+        # short enough to be invisible next to a full-context generate
+        scheduler = BatchScheduler(engine, max_wait_ms=30.0)
     return RagService(
         config, engine, llm_tokenizer, encoder, enc_tokenizer, store, scheduler=scheduler
     )

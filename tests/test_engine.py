@@ -317,3 +317,49 @@ class TestFusedProjections:
             engine_config=SMALL_ENGINE, dtypes=FP32, mesh=mesh_tp8,
         )
         assert "wq" in eng.params["layers"]["attn"]  # fused layout can't shard
+
+
+class TestTopPBisection:
+    """The sort-free nucleus filter must keep the same token set as the
+    full-sort oracle (modulo boundary ties, which random fp32 logits make
+    ~impossible)."""
+
+    def test_matches_sort_oracle(self):
+        from rag_llm_k8s_tpu.engine.sampling import top_p_filter, top_p_filter_sort
+
+        key = jax.random.PRNGKey(0)
+        for p in (0.1, 0.5, 0.9, 0.99):
+            for shape in ((4, 128), (2, 4096), (1, 128256)):
+                logits = jax.random.normal(jax.random.fold_in(key, shape[-1]),
+                                           shape, jnp.float32) * 3.0
+                got = top_p_filter(logits, p) > -1e8
+                want = top_p_filter_sort(logits, p) > -1e8
+                if bool(jnp.all(got == want)):
+                    continue
+                # fp32 softmax rounds distinct logits onto equal probs near
+                # the nucleus boundary: the two filters may disagree ONLY
+                # inside that ulp band, and the kept mass must still reach p
+                probs = jax.nn.softmax(logits, axis=-1)
+                boundary = jnp.min(
+                    jnp.where(want, probs, jnp.inf), axis=-1, keepdims=True
+                )
+                band = jnp.abs(probs - boundary) <= boundary * 1e-3
+                assert bool(jnp.all((got == want) | band)), (p, shape)
+                mass = jnp.sum(jnp.where(got, probs, 0.0), axis=-1)
+                assert bool(jnp.all(mass >= p - 1e-5)), (p, shape)
+
+    def test_peaked_and_flat_distributions(self):
+        from rag_llm_k8s_tpu.engine.sampling import top_p_filter, top_p_filter_sort
+
+        V = 1024
+        peaked = jnp.zeros((1, V)).at[0, 7].set(30.0)  # one token has ~all mass
+        flat = jnp.zeros((1, V))  # exact ties everywhere: keep-all superset
+        for p in (0.5, 0.9):
+            got = top_p_filter(peaked, p) > -1e8
+            want = top_p_filter_sort(peaked, p) > -1e8
+            assert bool(jnp.all(got == want))
+            # flat: every token ties at the boundary — bisection keeps all
+            # (documented superset); mass kept must still be >= top_p
+            kept = top_p_filter(flat, p) > -1e8
+            probs = jax.nn.softmax(flat, axis=-1)
+            assert float(jnp.sum(jnp.where(kept, probs, 0.0))) >= p
