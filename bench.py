@@ -249,19 +249,18 @@ def measure_query_e2e() -> dict:
             ),
             dtypes=dtypes,
         )
-        scheduler = None
-        if concurrency:
-            # under-load mode: concurrent requests coalesce into batched
-            # generate calls (BASELINE config #5) behind the coalesced
-            # embed+kNN stage (RagService.retrieve_coalescer): the fused
-            # retrieval of a concurrent burst runs as ONE padded device
-            # call, so arrivals reach the generate stage together and the
-            # production window (server/main.py: 30 ms) coalesces them.
-            # (Round 3 serialized each worker's retrieve fetch on the
-            # tunnel and needed a 1500 ms window to coalesce anything.)
-            from rag_llm_k8s_tpu.engine.batching import BatchScheduler
+        # EVERY mode serves through the production scheduler + retrieval
+        # coalescer with the production windows (server/main.py: 30 ms
+        # generate, app.py: 25 ms retrieve) — the solo p50 must include the
+        # window latency a production solo query actually pays. Under
+        # concurrency, the coalesced embed+kNN stage runs a burst's fused
+        # retrieval as ONE padded device call, so arrivals reach the
+        # generate stage together and the 30 ms window coalesces them.
+        # (Round 3 serialized each worker's retrieve fetch on the tunnel
+        # and needed a 1500 ms window to coalesce anything.)
+        from rag_llm_k8s_tpu.engine.batching import BatchScheduler
 
-            scheduler = BatchScheduler(engine, max_wait_ms=30.0)
+        scheduler = BatchScheduler(engine, max_wait_ms=30.0)
         service = RagService(
             app_cfg, engine, tok, encoder, enc_tok, store, scheduler=scheduler
         )
@@ -375,6 +374,7 @@ def measure_query_e2e() -> dict:
             assert r.status_code == 200 and "generated_text" in body, body
             for k in stages:
                 stages[k].append(body["timings"][k])
+        service.shutdown()
         lat_ms.sort()
         return lat_ms, stages, ingest_s
 

@@ -99,10 +99,15 @@ class Coalescer:
                 deadline = time.monotonic() + self.max_wait_ms / 1e3
                 while len(batch) < self.max_batch:
                     remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
                     try:
-                        nxt = self._queue.get(timeout=remaining)
+                        # past the deadline, still DRAIN whatever is already
+                        # queued (zero wait) — with max_wait_ms=0 this is
+                        # the whole contract: items that accumulated while
+                        # the worker was busy form one batch
+                        nxt = (
+                            self._queue.get(timeout=remaining)
+                            if remaining > 0 else self._queue.get_nowait()
+                        )
                     except queue.Empty:
                         break
                     if nxt is None:
@@ -224,10 +229,13 @@ class BatchScheduler:
             deadline = time.monotonic() + self.max_wait_ms / 1e3
             while len(batch) < cap:
                 remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
                 try:
-                    nxt = self._queue.get(timeout=remaining)
+                    # past the deadline, still drain already-queued items
+                    # (zero wait) — they accumulated while this worker ran
+                    nxt = (
+                        self._queue.get(timeout=remaining)
+                        if remaining > 0 else self._queue.get_nowait()
+                    )
                 except queue.Empty:
                     break
                 if nxt is None:

@@ -41,12 +41,15 @@ def top_p_filter(logits: jax.Array, top_p: float, iters: int = 30) -> jax.Array:
 
     Sort-free: bisect the probability threshold ``t`` such that the mass of
     ``{p_i > t}`` still reaches ``top_p`` — ``iters`` fused linear passes
-    over the row instead of an O(V log^2 V) bitonic sort (the sort measured
-    ~40% of the whole 1B decode step at the 128256 vocab; see
-    docs/DECODE_PERF.md). After 30 halvings the bracket is below fp32
-    resolution of any boundary probability, so the kept set equals the
-    sort-based oracle's up to boundary TIES — where this keeps every tied
-    token (a superset; HF's sort keeps an arbitrary subset of the tie).
+    over the row instead of an O(V log^2 V) bitonic sort (the sort was a
+    material slice of the 1B decode step at the 128256 vocab; see
+    docs/DECODE_PERF.md). After 30 halvings the bracket has width
+    ``pmax * 2^-30``: the kept set equals the sort-based oracle's except
+    (a) boundary TIES, where this keeps every tied token (HF's sort keeps
+    an arbitrary subset), and (b) tokens whose probability lies within the
+    final bracket of the true threshold — at most ``pmax * 1e-9`` of extra
+    mass per such token, distributionally negligible but not bit-identical
+    (the parity test compares within that band).
     """
     probs = jax.nn.softmax(logits, axis=-1)
     pmax = jnp.max(probs, axis=-1, keepdims=True)

@@ -223,3 +223,45 @@ class TestCoalescer:
         co.shutdown()
         with pytest.raises(RuntimeError, match="shut down"):
             co.submit(1, timeout=5)
+
+    def test_zero_window_still_drains_queued_items(self):
+        """max_wait_ms=0 contract: items that accumulated while the worker
+        was busy must form ONE batch (the deadline never blocks draining
+        what is already queued)."""
+        from rag_llm_k8s_tpu.engine.batching import Coalescer
+
+        calls = []
+        lock = threading.Lock()
+        gate = threading.Event()
+
+        def batch_fn(items):
+            with lock:
+                calls.append(list(items))
+            if len(calls) == 1:
+                gate.wait(10)  # hold the first batch until the rest queue up
+            return [x * 10 for x in items]
+
+        co = Coalescer(batch_fn, max_batch=8, max_wait_ms=0.0)
+        try:
+            results = [None] * 5
+
+            def run(i):
+                results[i] = co.submit(i, timeout=30)
+
+            t0 = threading.Thread(target=run, args=(0,))
+            t0.start()
+            while not calls:  # first item is now in flight
+                time.sleep(0.001)
+            rest = [threading.Thread(target=run, args=(i,)) for i in range(1, 5)]
+            for t in rest:
+                t.start()
+            time.sleep(0.05)  # the 4 are queued behind the held batch
+            gate.set()
+            t0.join(30)
+            for t in rest:
+                t.join(30)
+            assert results == [i * 10 for i in range(5)]
+            assert len(calls) == 2, calls  # 1 held batch + ONE drained batch of 4
+            assert sorted(calls[1]) == [1, 2, 3, 4]
+        finally:
+            co.shutdown()
