@@ -301,6 +301,19 @@ class EngineConfig:
     # every decode step streams, and fits 8B weights on one 16 GB chip;
     # see models.llama.quantize_llama_params). Training always stays bf16.
     weight_quant: str = "bf16"
+    # speculative decoding for the one-shot engine's GREEDY batch-1 path
+    # (the single-request latency case): "prompt_lookup" proposes the
+    # spec_tokens tokens that followed the most recent in-context repeat of
+    # the trailing spec_ngram-gram (RAG answers quote their context, so
+    # repeats are common), verifies all of them in ONE forward — decode is
+    # weight-bandwidth-bound, so a k+1-wide verify step costs ~one decode
+    # step — and accepts the longest prefix that matches the model's own
+    # greedy choices. Output is token-IDENTICAL to vanilla greedy decode
+    # (tests/test_speculative.py); sampling or batch>1 requests fall back
+    # to the vanilla loop. Env: TPU_RAG_SPECULATIVE.
+    speculative: str = "off"  # "off" | "prompt_lookup"
+    spec_ngram: int = 3
+    spec_tokens: int = 7  # proposals per verify step (k+1 = 8 fed tokens)
     # continuous engine: decode steps executed per host sync. 1 = admit and
     # retire between every step (lowest admission latency). >1 runs k steps
     # as ONE device program (lax.scan) and fetches the [k, B] token plane
@@ -431,6 +444,20 @@ class AppConfig:
                     f"TPU_RAG_WARM_FULL_LADDER={flag!r}: expected '0' or '1'"
                 )
             engine = dataclasses.replace(engine, warm_full_ladder=flag == "1")
+        if "TPU_RAG_DO_SAMPLE" in env:
+            flag = env["TPU_RAG_DO_SAMPLE"]
+            if flag not in ("0", "1"):
+                raise ValueError(
+                    f"TPU_RAG_DO_SAMPLE={flag!r}: expected '0' or '1'"
+                )
+            sampling = dataclasses.replace(sampling, do_sample=flag == "1")
+        if "TPU_RAG_SPECULATIVE" in env:
+            spec = env["TPU_RAG_SPECULATIVE"]
+            if spec not in ("off", "prompt_lookup"):
+                raise ValueError(
+                    f"TPU_RAG_SPECULATIVE={spec!r}: expected 'off' or 'prompt_lookup'"
+                )
+            engine = dataclasses.replace(engine, speculative=spec)
         if "TPU_RAG_SYNC_STEPS" in env:
             k = int(env["TPU_RAG_SYNC_STEPS"])
             if k < 1:

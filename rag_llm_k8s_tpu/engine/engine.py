@@ -125,6 +125,9 @@ class EngineStats:
     prefill_tokens: int = 0
     decode_tokens: int = 0
     generate_calls: int = 0
+    # speculative decoding: verify forwards run (each emits >= 1 token);
+    # decode_tokens / spec_verify_steps over a spec run = tokens per step
+    spec_verify_steps: int = 0
 
 
 class InferenceEngine:
@@ -149,6 +152,15 @@ class InferenceEngine:
         if engine_config.kv_quant not in ("bf16", "int8"):
             raise ValueError(
                 f"kv_quant={engine_config.kv_quant!r}: expected 'bf16' or 'int8'"
+            )
+        if engine_config.speculative == "prompt_lookup" and sampling.do_sample:
+            # the knob only serves greedy batch-1 requests: surface the
+            # no-op loudly instead of silently decoding vanilla forever
+            logger.warning(
+                "speculative='prompt_lookup' configured but sampling is "
+                "enabled (do_sample=True): speculation only serves GREEDY "
+                "requests — set TPU_RAG_DO_SAMPLE=0 (or per-request greedy) "
+                "for it to activate"
             )
         self.params, fused = maybe_fuse_params(params, engine_config, mesh)
         self.params, quantized = maybe_quantize_params(self.params, engine_config)
@@ -281,6 +293,127 @@ class InferenceEngine:
             .compile()
         )
 
+    def _build_generate_spec(self, S: int, max_new: int):
+        """AOT-compile the SPECULATIVE greedy batch-1 generate executable
+        (``EngineConfig.speculative="prompt_lookup"``).
+
+        Each loop iteration feeds ``k+1`` tokens — the pending last token
+        plus the ``k`` tokens that followed the most recent in-context
+        repeat of the trailing ``n``-gram — through the offset-causal
+        chunked model (ONE forward ≈ one decode step's weight traffic),
+        then keeps the longest proposal prefix matching the model's own
+        greedy argmax plus the correction token. Rejected proposals cost
+        nothing to undo: the KV frontier simply doesn't advance over their
+        slots, and later iterations overwrite them (the same windowed-mask
+        machinery chunked prefill already relies on). Output is
+        token-identical to the vanilla greedy loop by construction: every
+        emitted token IS a greedy argmax given the accepted prefix.
+        """
+        cfg, dt = self.config, self.dtypes
+        model = self.model
+        mc = self.model_chunked
+        n = max(1, self.engine_config.spec_ngram)
+        k = max(1, self.engine_config.spec_tokens)
+        # k extra cache slots: the LAST verify forward can start as late as
+        # slot S+max_new-2 and still writes k+1 slots. Without the slack,
+        # dynamic_update_slice CLAMPS the out-of-range write start, silently
+        # shifting the whole block left over valid accepted-token KV — the
+        # exactness contract would break precisely near the token budget.
+        T = -(-(S + max_new + k) // 128) * 128
+        eos_ids = cfg.eos_token_ids
+        cache_dtype = dt.compute_dtype
+        pad_id = self.pad_id
+        i32 = jnp.int32
+
+        def gen(params, tokens, pad_mask, rng):  # rng unused: greedy only
+            cache = make_kv_cache(
+                cfg, 1, T, cache_dtype, quant=self.engine_config.kv_quant
+            )
+            kv_start, _ = mask_window(pad_mask)
+            real_len = jnp.sum(pad_mask, axis=-1)  # [1]
+            positions = jnp.clip(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
+            logits, cache = model.apply(
+                {"params": params}, tokens, positions, cache,
+                kv_start, jnp.full((1,), S, i32), i32(0),
+                last_logit_only=True,
+            )
+            tok0 = jnp.argmax(logits[:, -1], axis=-1).astype(i32)  # [1]
+            done0 = _isin(tok0, eos_ids)[0]
+            # out and hist carry k+1 slack slots: every scatter below then
+            # uses UNIQUE per-lane indices (e + j / wi + 1 + j) — clipping
+            # into the last slot instead would create duplicate indices,
+            # and XLA scatter picks an arbitrary winner among duplicates
+            out0 = jnp.full((1, max_new + k + 1), pad_id, i32).at[:, 0].set(tok0)
+            # token history mirrors cache slots: prompt at [0, S) (left-
+            # padded exactly like the cache), emitted token j at S + j
+            hist0 = jnp.full((1, T + k + 1), pad_id, i32)
+            hist0 = jax.lax.dynamic_update_slice(hist0, tokens, (0, 0))
+            hist0 = hist0.at[:, S].set(tok0)
+
+            def cond(c):
+                e, _, _, done, _, _ = c
+                return (e < max_new) & ~done
+
+            def body(c):
+                e, cache, hist, done, out, iters = c
+                wi = (S + e - 1).astype(i32)  # slot of the pending token
+                row = hist[0]
+                last_tok = jax.lax.dynamic_slice(row, (wi,), (1,))  # [1]
+                # ---- propose: last occurrence of the trailing n-gram ----
+                match = jnp.ones((T + k + 1,), bool)
+                for j in range(n):
+                    tj = jax.lax.dynamic_slice(row, (wi - j,), (1,))[0]
+                    # candidate c matches iff hist[c - j] == hist[wi - j];
+                    # roll wraps but candidates below kv_start+n-1 are
+                    # masked out, so wrapped lanes never survive
+                    match = match & (jnp.roll(row, j) == tj)
+                idx = jnp.arange(T + k + 1, dtype=i32)
+                match = match & (idx >= kv_start[0] + n - 1) & (idx < wi)
+                c_star = jnp.max(jnp.where(match, idx, -1))
+                src = jnp.where(c_star >= 0, c_star + 1, 0).astype(i32)
+                props = jax.lax.dynamic_slice(row, (src,), (k,))  # [k]
+                # (no-match proposals are arbitrary history — harmless:
+                # acceptance only ever keeps tokens equal to the greedy
+                # choice, so garbage proposals just mean m = 0)
+                fed = jnp.concatenate([last_tok, props])[None, :]  # [1, k+1]
+                pos = (real_len[0] - 1 + e + jnp.arange(k + 1, dtype=i32))[None, :]
+                kv_len = jnp.full((1,), wi + k + 1, i32)
+                logits, cache = mc.apply(
+                    {"params": params}, fed, pos, cache, kv_start, kv_len, wi
+                )
+                g = jnp.argmax(logits[0], axis=-1).astype(i32)  # [k+1] greedy
+                # longest accepted proposal prefix, then the correction token
+                acc = jnp.cumprod((props == g[:k]).astype(i32))
+                m = jnp.sum(acc)
+                j_idx = jnp.arange(k + 1, dtype=i32)
+                is_eos = _isin(g, eos_ids)
+                eos_pos = jnp.min(jnp.where(is_eos & (j_idx <= m), j_idx, k + 1))
+                m_eff = jnp.minimum(jnp.minimum(m, eos_pos), max_new - e - 1)
+                emit = j_idx <= m_eff
+                out_idx = e + j_idx  # unique lanes (slack-padded buffer)
+                out_row = out[0].at[out_idx].set(
+                    jnp.where(emit, g, out[0][out_idx])
+                )
+                hist_idx = wi + 1 + j_idx
+                hist_row = row.at[hist_idx].set(jnp.where(emit, g, row[hist_idx]))
+                done = done | (eos_pos <= m_eff)
+                return (
+                    e + m_eff + 1, cache, hist_row[None], done, out_row[None],
+                    iters + 1,
+                )
+
+            init = (i32(1), cache, hist0, done0, out0, i32(0))
+            _, _, _, _, out, iters = jax.lax.while_loop(cond, body, init)
+            # iters = verify forwards run; the emitted-token count over it
+            # is the measured acceptance rate (EngineStats.spec_verify_steps)
+            return out[:, :max_new], iters
+
+        avals = param_avals(self.params)
+        data_sharding = self.mesh.replicated if self.mesh is not None else None
+        tok_aval = jax.ShapeDtypeStruct((1, S), jnp.int32, sharding=data_sharding)
+        rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=data_sharding)
+        return jax.jit(gen).lower(avals, tok_aval, tok_aval, rng_aval).compile()
+
     def _get_compiled(
         self, B: int, S: int, max_new: int, chunk: Optional[int] = None
     ) -> jax.stages.Compiled:
@@ -288,11 +421,24 @@ class InferenceEngine:
         with self._lock:
             fn = self._compiled.get(key)
         if fn is None:
-            fn = self._build_generate(B, S, max_new, chunk)
+            if chunk == "spec":
+                fn = self._build_generate_spec(S, max_new)
+            else:
+                fn = self._build_generate(B, S, max_new, chunk)
             with self._lock:
                 self._compiled.setdefault(key, fn)
                 fn = self._compiled[key]
         return fn
+
+    def _spec_applicable(self, n_prompts: int, chunk) -> bool:
+        """Prompt-lookup speculation serves exactly the greedy batch-1
+        single-shot case; everything else falls back to the vanilla loop."""
+        return (
+            self.engine_config.speculative == "prompt_lookup"
+            and n_prompts == 1
+            and not self.sampling.do_sample
+            and chunk is None
+        )
 
     # ------------------------------------------------------------------
     # host-side API
@@ -398,9 +544,16 @@ class InferenceEngine:
             tokens[i, -1] = self.config.bos_token_id
             pad_mask[i, -1] = 1
 
-        fn = self._get_compiled(B, S, max_new, chunk)
+        spec = self._spec_applicable(len(prompts), chunk)
+        fn = self._get_compiled(B, S, max_new, "spec" if spec else chunk)
         tokens_j, mask_j, rng_j = self._place_inputs(tokens, pad_mask, rng)
-        out = np.asarray(fn(self.params, tokens_j, mask_j, rng_j))
+        if spec:
+            out, iters = fn(self.params, tokens_j, mask_j, rng_j)
+            out = np.asarray(out)
+            with self._lock:
+                self.stats.spec_verify_steps += int(iters)
+        else:
+            out = np.asarray(fn(self.params, tokens_j, mask_j, rng_j))
 
         results: List[List[int]] = []
         eos = set(self.config.eos_token_ids)
@@ -442,4 +595,9 @@ class InferenceEngine:
         max_new = max_new_tokens or self.sampling.max_new_tokens
         for b in batch_sizes:
             for s in buckets:
-                self._get_compiled(self._bucket_batch(b), s, self._clamp_max_new(s, max_new))
+                mb = self._bucket_batch(b)
+                mn = self._clamp_max_new(s, max_new)
+                if mb == 1 and self._spec_applicable(1, None):
+                    self._get_compiled(1, s, mn, "spec")
+                else:
+                    self._get_compiled(mb, s, mn)

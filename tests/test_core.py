@@ -98,6 +98,17 @@ class TestReferenceParityDefaults:
         with pytest.raises(ValueError):
             AppConfig.from_env({"TPU_RAG_WARM_FULL_LADDER": "true"})
 
+    def test_from_env_speculative(self):
+        c = AppConfig.from_env(
+            {"TPU_RAG_SPECULATIVE": "prompt_lookup", "TPU_RAG_DO_SAMPLE": "0"}
+        )
+        assert c.engine.speculative == "prompt_lookup"
+        assert c.sampling.do_sample is False
+        with pytest.raises(ValueError):
+            AppConfig.from_env({"TPU_RAG_SPECULATIVE": "ngram"})
+        with pytest.raises(ValueError):
+            AppConfig.from_env({"TPU_RAG_DO_SAMPLE": "yes"})
+
     def test_from_env_sync_steps(self):
         c = AppConfig.from_env({"TPU_RAG_SYNC_STEPS": "8"})
         assert c.engine.decode_sync_steps == 8

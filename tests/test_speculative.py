@@ -1,0 +1,136 @@
+"""Prompt-lookup speculative decoding (EngineConfig.speculative): the greedy
+batch-1 fast path must be token-IDENTICAL to the vanilla loop on every input
+— acceptance only ever keeps tokens equal to the model's own greedy argmax —
+while the all-accept regime provably emits k+1 tokens per verify forward."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from rag_llm_k8s_tpu.core.config import (
+    DTypePolicy,
+    EngineConfig,
+    LlamaConfig,
+    SamplingConfig,
+)
+from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+from rag_llm_k8s_tpu.models.llama import init_llama_params
+
+FP32 = DTypePolicy.fp32()
+GREEDY = SamplingConfig(do_sample=False, max_new_tokens=12)
+ENG = EngineConfig(prompt_buckets=(32, 64), max_batch_size=2, max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny()
+    params = init_llama_params(jax.random.PRNGKey(0), cfg, FP32)
+    vanilla = InferenceEngine(cfg, params, sampling=GREEDY, engine_config=ENG, dtypes=FP32)
+    spec = InferenceEngine(
+        cfg, params, sampling=GREEDY,
+        engine_config=dataclasses.replace(ENG, speculative="prompt_lookup"),
+        dtypes=FP32,
+    )
+    return cfg, params, vanilla, spec
+
+
+PROMPTS = [
+    [3, 17, 42, 7, 99],  # no obvious repeats
+    [5, 9, 2, 5, 9, 2, 5, 9, 2],  # trailing n-gram repeats in-prompt
+    [11] * 20,  # degenerate repeat
+    [3, 17, 42, 7, 99, 3, 17, 42],  # repeat ending mid-span
+    [8],  # shorter than the n-gram itself
+    list(range(3, 30)),  # long distinct prompt
+]
+
+
+class TestExactness:
+    def test_matches_vanilla_greedy(self, setup):
+        _, _, vanilla, spec = setup
+        for p in PROMPTS:
+            want = vanilla.generate([p])[0]
+            got = spec.generate([p])[0]
+            assert got == want, p
+
+    def test_budget_edges(self, setup):
+        _, _, vanilla, spec = setup
+        p = [5, 9, 2, 5, 9, 2, 5, 9, 2]
+        for mn in (1, 2, 7, 8, 9, 20):  # around k+1 = 8 emission chunks
+            assert spec.generate([p], max_new_tokens=mn)[0] == \
+                vanilla.generate([p], max_new_tokens=mn)[0], mn
+
+    def test_zero_slack_cache_shape_stays_exact(self, setup):
+        """S + max_new an exact 128-multiple (the round-4 bench's own
+        shapes): without k slack slots, the last verify forwards' KV writes
+        would clamp-shift onto valid accepted KV and diverge near the
+        budget. Repeat-heavy prompt drives acceptance right to the edge."""
+        _, _, vanilla, spec = setup
+        p = [5, 9, 2] * 6  # repeats: long accepted spans reach the budget
+        for mn in (96, 95):  # 32 + 96 = 128 exactly
+            want = vanilla.generate([p], max_new_tokens=mn)[0]
+            got = spec.generate([p], max_new_tokens=mn)[0]
+            assert got == want, mn
+
+    def test_eos_mid_span(self, setup):
+        """EOS inside an accepted span must truncate exactly where vanilla
+        does. The EOS id is taken from the vanilla stream so it fires."""
+        cfg, params, vanilla, _ = setup
+        p = [5, 9, 2, 5, 9, 2, 5, 9, 2]
+        stream = vanilla.generate([p])[0]
+        assert len(stream) >= 4
+        cfg_eos = dataclasses.replace(cfg, eos_token_ids=(stream[3],))
+        v2 = InferenceEngine(cfg_eos, params, sampling=GREEDY, engine_config=ENG, dtypes=FP32)
+        s2 = InferenceEngine(
+            cfg_eos, params, sampling=GREEDY,
+            engine_config=dataclasses.replace(ENG, speculative="prompt_lookup"),
+            dtypes=FP32,
+        )
+        want = v2.generate([p])[0]
+        got = s2.generate([p])[0]
+        assert got == want
+        assert len(want) == 3  # truncated at the injected EOS
+
+    def test_fallbacks_to_vanilla(self, setup):
+        cfg, params, vanilla, spec = setup
+        # batch > 1: vanilla path (still correct)
+        two = spec.generate([[3, 17, 42], [5, 9, 2]])
+        assert two == vanilla.generate([[3, 17, 42], [5, 9, 2]])
+        assert (2, 32, GREEDY.max_new_tokens, None) in spec._compiled
+        # sampling: vanilla path
+        sam = InferenceEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=True, max_new_tokens=6, seed=3),
+            engine_config=dataclasses.replace(ENG, speculative="prompt_lookup"),
+            dtypes=FP32,
+        )
+        sam.generate([[3, 17, 42]], seed=7)
+        assert not any(k[3] == "spec" for k in sam._compiled)
+
+
+class TestAcceptance:
+    def test_all_accept_regime_emits_k_plus_1_per_step(self, setup):
+        """Zero params make the model a constant emitter (uniform logits →
+        argmax 0 forever); a prompt seeded with 0-runs makes every proposal
+        correct, so max_new tokens must arrive in ceil((max_new-1)/(k+1))
+        verify steps — the machinery's best case, measured not assumed."""
+        cfg, _, _, _ = setup
+        params0 = jax.tree.map(
+            lambda x: np.zeros_like(x), init_llama_params(jax.random.PRNGKey(0), cfg, FP32)
+        )
+        ec = dataclasses.replace(ENG, speculative="prompt_lookup")
+        spec = InferenceEngine(cfg, params0, sampling=GREEDY, engine_config=ec, dtypes=FP32)
+        p = [1] + [0] * 8
+        out = spec.generate([p], max_new_tokens=12)[0]
+        assert out == [0] * 12
+        k1 = ec.spec_tokens + 1
+        want_steps = -(-(12 - 1) // k1)
+        assert spec.stats.spec_verify_steps == want_steps
+
+    def test_verify_steps_never_exceed_tokens(self, setup):
+        _, _, _, spec = setup
+        before = spec.stats.spec_verify_steps
+        out = spec.generate([[3, 17, 42, 7, 99]], max_new_tokens=9)[0]
+        steps = spec.stats.spec_verify_steps - before
+        assert 1 <= steps <= len(out)
