@@ -617,11 +617,14 @@ def measure_knn_scale() -> dict:
 
     from rag_llm_k8s_tpu.ops.knn import knn_topk_pallas, knn_topk_xla
 
-    D, K, M = 1024, 5, 20
+    D, K = 1024, 5
     rtt_ms = measure_tunnel_fetch_ms()
     out = {}
     q = jax.random.normal(jax.random.PRNGKey(1), (1, D), jnp.float32)
-    for N, label in ((100_352, "100k"), (1_000_448, "1m")):  # 512-multiples
+    # more dispatches at the small size: per-query device time there
+    # (~0.3-0.5 ms) is far below the link RTT, so it needs deep
+    # amortization to resolve at all
+    for N, label, M in ((100_352, "100k", 200), (1_000_448, "1m", 20)):
         emb = jax.random.normal(jax.random.PRNGKey(0), (N, D), jnp.float32)
         norms = jnp.sum(emb * emb, axis=1)[None, :]
         for name, fn in (("knn", knn_topk_pallas), ("knn_xla", knn_topk_xla)):
@@ -706,6 +709,28 @@ def measure_speculative() -> dict:
             4 * len(s_out) / max(steps, 1), 2  # 4 timed generate calls
         )
         del params, van, spc
+
+    # the FLAGSHIP latency point: 8B int8+int8-KV at batch 1, all-accept
+    # bound — what a RAG answer that quotes its context approaches
+    from rag_llm_k8s_tpu.models.llama import quantize_llama_params
+
+    cfg8 = LlamaConfig.llama_3_1_8b()
+    qshapes = jax.eval_shape(
+        quantize_llama_params,
+        jax.eval_shape(lambda: init_llama_params(jax.random.PRNGKey(0), cfg8, dtypes)),
+    )
+    params8 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), qshapes)
+    ec8 = dataclasses.replace(ec, weight_quant="int8", kv_quant="int8")
+    prompt = [cfg8.bos_token_id] + [0] * 16
+    outs8 = {}
+    for label, e in (("vanilla", ec8), ("spec", dataclasses.replace(ec8, speculative="prompt_lookup"))):
+        eng = InferenceEngine(cfg8, params8, sampling=G, engine_config=e, dtypes=dtypes)
+        tps, outs8[label] = best_tok_per_s(eng, prompt)
+        key = "spec_8b_b1_all_accept" if label == "spec" else "spec_8b_b1_vanilla"
+        out[f"{key}_tok_per_s"] = round(tps, 1)
+        del eng
+    assert outs8["spec"] == outs8["vanilla"], "8B speculation diverged from greedy"
+    del params8
     return out
 
 

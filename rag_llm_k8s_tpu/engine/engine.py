@@ -153,14 +153,18 @@ class InferenceEngine:
             raise ValueError(
                 f"kv_quant={engine_config.kv_quant!r}: expected 'bf16' or 'int8'"
             )
+        if engine_config.speculative not in ("off", "prompt_lookup"):
+            raise ValueError(
+                f"speculative={engine_config.speculative!r}: expected "
+                "'off' or 'prompt_lookup'"
+            )
         if engine_config.speculative == "prompt_lookup" and sampling.do_sample:
             # the knob only serves greedy batch-1 requests: surface the
             # no-op loudly instead of silently decoding vanilla forever
             logger.warning(
                 "speculative='prompt_lookup' configured but sampling is "
                 "enabled (do_sample=True): speculation only serves GREEDY "
-                "requests — set TPU_RAG_DO_SAMPLE=0 (or per-request greedy) "
-                "for it to activate"
+                "requests — set TPU_RAG_DO_SAMPLE=0 for it to activate"
             )
         self.params, fused = maybe_fuse_params(params, engine_config, mesh)
         self.params, quantized = maybe_quantize_params(self.params, engine_config)

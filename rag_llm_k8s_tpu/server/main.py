@@ -132,6 +132,15 @@ def build_service():
     )
 
     if config.engine.batching == "continuous":
+        if config.engine.speculative != "off":
+            # the slot-based engine has no speculative path; without this
+            # the knob would be silently inert behind the scheduler
+            logger.warning(
+                "TPU_RAG_SPECULATIVE is configured but TPU_RAG_BATCHING="
+                "'continuous' routes requests through the slot engine, "
+                "which does not speculate — use batching='coalesce' (the "
+                "default) for speculation to serve"
+            )
         from rag_llm_k8s_tpu.engine.continuous import (
             ContinuousEngine,
             ContinuousScheduler,

@@ -134,3 +134,26 @@ class TestAcceptance:
         out = spec.generate([[3, 17, 42, 7, 99]], max_new_tokens=9)[0]
         steps = spec.stats.spec_verify_steps - before
         assert 1 <= steps <= len(out)
+
+
+class TestSpecWithQuantization:
+    """Speculation composes with int8 weights and the int8 KV cache: the
+    verify forward is the q8 chunked-prefill path, acceptance compares the
+    QUANTIZED model's own greedy choices — exactness is vs the quantized
+    vanilla loop (the same numerics)."""
+
+    def test_exact_vs_vanilla_int8_w_and_kv(self):
+        cfg = LlamaConfig.tiny()
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, FP32)
+        ec = dataclasses.replace(ENG, weight_quant="int8", kv_quant="int8")
+        vanilla = InferenceEngine(cfg, params, sampling=GREEDY, engine_config=ec, dtypes=FP32)
+        spec = InferenceEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=dataclasses.replace(ec, speculative="prompt_lookup"),
+            dtypes=FP32,
+        )
+        for p in ([3, 17, 42, 7, 99], [5, 9, 2] * 5, [11] * 16):
+            want = vanilla.generate([p])[0]
+            got = spec.generate([p])[0]
+            assert got == want, p
+        assert spec.stats.spec_verify_steps > 0
