@@ -808,7 +808,7 @@ def measure_continuous() -> dict:
             ),
             dtypes=dtypes,
         )
-        eng.warmup()
+        eng.warmup(batch_sizes=(B,))  # admission-group ladder too
         sched = ContinuousScheduler(eng)
         sched.submit(prompts[0], timeout=600)  # end-to-end warm
         steps0 = eng.steps

@@ -280,10 +280,10 @@ class EngineConfig:
     max_chunked_prompt: int = 16384
     # request scheduling: "coalesce" = group compatible requests at start
     # (engine/batching.py) — the default: its one device program per batch
-    # measured 1726 tok/s vs the continuous engine's 232 on the round-4
+    # measured ~1750 tok/s vs the continuous engine's ~300 on the round-4
     # steady-state bench (BENCH_r04, saturating stream, same 1B model,
     # concurrency 8), because slot-based serving pays a host sync per
-    # admission and per decode window. "continuous" = slot-based decode,
+    # admission GROUP and per decode window. "continuous" = slot-based decode,
     # requests join the running batch between steps (engine/continuous.py)
     # — pick it on DIRECTLY-ATTACHED hosts (sync cost ~μs, not the
     # tunnel's ~130-200 ms) when streaming arrivals make time-to-first-

@@ -55,7 +55,7 @@ from rag_llm_k8s_tpu.engine.engine import (
     maybe_quantize_params,
     param_avals,
 )
-from rag_llm_k8s_tpu.engine.sampling import sample_token, sample_token_per_row
+from rag_llm_k8s_tpu.engine.sampling import sample_token_per_row
 from rag_llm_k8s_tpu.models.llama import LlamaModel, make_kv_cache, mask_window
 from rag_llm_k8s_tpu.utils.buckets import bucket_len
 
@@ -123,7 +123,7 @@ class ContinuousEngine:
             fused_qkv=fused, quantized=quantized, kv_quant=self.kv_quant,
         )
         self.model_step = self.model.copy(row_frontier=True)
-        self._compiled: Dict[Tuple[str, int], jax.stages.Compiled] = {}
+        self._compiled: Dict[Tuple[str, int, int], jax.stages.Compiled] = {}
         # ---- persistent device state -----------------------------------
         # the cache rides as a TUPLE pytree through every executable:
         # (k, v) bf16, or (k, v, k_scale, v_scale) with kv_quant="int8" —
@@ -144,14 +144,25 @@ class ContinuousEngine:
         self.stats = EngineStats()  # /metrics parity with InferenceEngine
 
     def warmup(self, batch_sizes=None, buckets=None):
-        """AOT-compile every executable serving will hit (readiness gating);
-        ``batch_sizes`` is accepted for InferenceEngine API parity — slot
-        geometry is fixed at construction."""
+        """AOT-compile every executable serving will hit (readiness gating).
+        ``batch_sizes`` here sizes the ADMISSION-group ladder (rounded to
+        powers of two): a scheduler that admits queued requests in groups
+        should warm the group sizes it will use, or the first burst pays a
+        mid-serving compile. Slot geometry itself is fixed at construction."""
+        sizes = {1}
+        for b in batch_sizes or (1,):
+            n = 1
+            while n * 2 <= min(max(1, b), self.B):
+                n *= 2
+                sizes.add(n)  # the WHOLE pow2 ladder: admit_many splits
+                # arbitrary group sizes into pow2 chunks, so every rung
+                # below the cap is reachable at runtime
         for S in buckets or self.buckets:
             if S not in self.buckets:
                 continue  # admit can never use a bucket without decode room
-            self._get("prefill", S)
-            self._get("insert", S)
+            for n in sorted(sizes):
+                self._get("prefill", S, n)
+                self._get("insert", S, n)
         self._get("step", self.sync_steps)
 
     def _put(self, x, sharding=None):
@@ -198,13 +209,16 @@ class ContinuousEngine:
     # ------------------------------------------------------------------
     # executables
     # ------------------------------------------------------------------
-    def _get(self, kind: str, S: int):
-        key = (kind, S)
+    def _get(self, kind: str, S: int, n: int = 1):
+        key = (kind, S, n)
         fn = self._compiled.get(key)
         if fn is None:
-            fn = {"prefill": self._build_prefill,
-                  "insert": self._build_insert,
-                  "step": self._build_step}[kind](S)
+            if kind == "step":
+                fn = self._build_step(S)  # S carries the sync window here
+            elif kind == "prefill":
+                fn = self._build_prefill(S, n)
+            else:
+                fn = self._build_insert(S, n)
             self._compiled[key] = fn
         return fn
 
@@ -250,27 +264,31 @@ class ContinuousEngine:
             return (payload, payload, scale, scale)
         return (payload, payload)
 
-    def _build_prefill(self, S: int):
+    def _build_prefill(self, S: int, n: int = 1):
+        """``n`` requests prefill together into fresh S-length row caches —
+        batched admission amortizes the per-admission dispatch + first-token
+        fetch (decisive on a slow host link: one round-trip per GROUP).
+        Per-row pre-folded keys keep draws (seed, position)-deterministic
+        regardless of the admission grouping."""
         cfg, dt, sampling = self.config, self.dtypes, self.sampling
         model = self.model
         kv_quant = self.kv_quant
 
-        def prefill(params, tokens, pad_mask, rng):
-            # B=1 single-shot prefill into a fresh S-length row cache
-            cache = make_kv_cache(cfg, 1, S, dt.compute_dtype, quant=kv_quant)
+        def prefill(params, tokens, pad_mask, rngs):
+            cache = make_kv_cache(cfg, n, S, dt.compute_dtype, quant=kv_quant)
             kv_start, _ = mask_window(pad_mask)
             positions = jnp.clip(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
             logits, cache = model.apply(
                 {"params": params}, tokens, positions, cache,
-                kv_start, jnp.full((1,), S, jnp.int32), jnp.int32(0),
+                kv_start, jnp.full((n,), S, jnp.int32), jnp.int32(0),
                 last_logit_only=True,
             )
-            tok0 = sample_token(rng, logits[:, -1], sampling)[0]
-            row = (
+            tok0 = sample_token_per_row(rngs, logits[:, -1], sampling)
+            rows = (
                 (cache.k, cache.v, cache.k_scale, cache.v_scale)
                 if kv_quant == "int8" else (cache.k, cache.v)
             )
-            return row, tok0, kv_start[0]
+            return rows, tok0, kv_start
 
         rep = self.mesh.replicated if self.mesh is not None else None
         # pin output shardings so the row block arrives EXACTLY as insert's
@@ -281,28 +299,41 @@ class ContinuousEngine:
         )
         return jax.jit(prefill, out_shardings=out_shardings).lower(
             param_avals(self.params),
-            jax.ShapeDtypeStruct((1, S), jnp.int32, sharding=rep),
-            jax.ShapeDtypeStruct((1, S), jnp.int32, sharding=rep),
-            jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep),
+            jax.ShapeDtypeStruct((n, S), jnp.int32, sharding=rep),
+            jax.ShapeDtypeStruct((n, S), jnp.int32, sharding=rep),
+            jax.ShapeDtypeStruct((n, 2), jnp.uint32, sharding=rep),
         ).compile()
 
-    def _build_insert(self, S: int):
+    def _build_insert(self, S: int, n: int = 1):
+        """Splice ``n`` freshly prefilled row blocks + their per-row state
+        into arbitrary slots in ONE device call (the admission group's
+        counterpart to the batched prefill)."""
+
         def insert(cache, row_cache, kv_start, kv_len, last_tok, active,
-                   rng_keys, row, row_start, tok0, row_key):
-            # the row's prompt KV occupies slots [0, S); frontiers are per-row
-            # so nothing else moves. zip pairs each state plane (payload or
-            # scale) with its [L, 1, ...] row block — same update either way
-            cache = tuple(
-                jax.lax.dynamic_update_slice(
-                    c, r, (0, row) + (0,) * (c.ndim - 2)
+                   rng_keys, rows, row_starts, tok0s, row_keys):
+            # each row's prompt KV occupies slots [0, S); frontiers are
+            # per-row so nothing else moves. zip pairs each state plane
+            # (payload or scale) with its [L, n, ...] block — same update
+            # either way. The loop is static (n is compile-time).
+            for i in range(n):
+                blk = tuple(
+                    jax.lax.dynamic_slice(
+                        r, (0, i) + (0,) * (r.ndim - 2),
+                        (r.shape[0], 1) + r.shape[2:],
+                    )
+                    for r in row_cache
                 )
-                for c, r in zip(cache, row_cache)
-            )
-            kv_start = kv_start.at[row].set(row_start)
-            kv_len = kv_len.at[row].set(S)
-            last_tok = last_tok.at[row].set(tok0)
-            active = active.at[row].set(True)
-            rng_keys = rng_keys.at[row].set(row_key)
+                cache = tuple(
+                    jax.lax.dynamic_update_slice(
+                        c, b, (0, rows[i]) + (0,) * (c.ndim - 2)
+                    )
+                    for c, b in zip(cache, blk)
+                )
+                kv_start = kv_start.at[rows[i]].set(row_starts[i])
+                kv_len = kv_len.at[rows[i]].set(S)
+                last_tok = last_tok.at[rows[i]].set(tok0s[i])
+                active = active.at[rows[i]].set(True)
+                rng_keys = rng_keys.at[rows[i]].set(row_keys[i])
             return cache, kv_start, kv_len, last_tok, active, rng_keys
 
         i32 = jnp.int32
@@ -311,20 +342,20 @@ class ContinuousEngine:
             (self._cache_shardings(), rep, rep, rep, rep, rep)
             if self.mesh is not None else None
         )
-        # row_cache is not donated: a [L,1,...] block cannot alias into the
+        # row_cache is not donated: an [L,n,...] block cannot alias into the
         # [L,B,...] cache, so donation would only emit a warning
         return jax.jit(insert, donate_argnums=(0, 2, 3, 6), out_shardings=out_shardings).lower(
             self._cache_avals(self.B, self.T),
-            self._cache_avals(1, S),
+            self._cache_avals(n, S),
             jax.ShapeDtypeStruct((self.B,), i32, sharding=rep),
             jax.ShapeDtypeStruct((self.B,), i32, sharding=rep),
             jax.ShapeDtypeStruct((self.B,), i32, sharding=rep),
             jax.ShapeDtypeStruct((self.B,), bool, sharding=rep),
             jax.ShapeDtypeStruct((self.B, 2), jnp.uint32, sharding=rep),
-            jax.ShapeDtypeStruct((), i32, sharding=rep),
-            jax.ShapeDtypeStruct((), i32, sharding=rep),
-            jax.ShapeDtypeStruct((), i32, sharding=rep),
-            jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep),
+            jax.ShapeDtypeStruct((n,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((n,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((n,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((n, 2), jnp.uint32, sharding=rep),
         ).compile()
 
     def _build_step(self, k: int = 1):
@@ -421,55 +452,110 @@ class ContinuousEngine:
     ) -> Tuple[int, Optional[List[int]]]:
         """Prefill + insert into a free slot. Returns ``(slot, finished)``;
         ``finished`` is the complete token list when the request ends at its
-        very first token (EOS or max_new=1) without occupying a slot.
+        very first token (EOS or max_new=1) without keeping the slot."""
+        res = self.admit_many([(request_id, prompt, max_new, seed)])[0]
+        if isinstance(res, BaseException):
+            raise res
+        return res
 
-        The prompt is bucketed over the FULL bucket ladder and ``max_new`` is
-        clamped to the remaining cache room (mirroring
-        ``InferenceEngine._clamp_max_new``) — the prompt is never cut to make
-        room for generation. Only a prompt over the largest bucket truncates,
-        loudly (continuous slots are fixed-length; route such prompts through
-        ``InferenceEngine``'s chunked prefill instead)."""
+    def admit_many(
+        self, items: Sequence[Tuple[int, Sequence[int], int, Optional[int]]]
+    ) -> List[Tuple[int, Optional[List[int]]]]:
+        """Admit a GROUP of requests: same-bucket requests prefill together
+        (one batched forward), splice into their slots in one insert call,
+        and their first tokens return in ONE device→host fetch — the
+        per-admission round-trip (the continuous engine's biggest cost on a
+        slow host link) amortizes over the group. Returns ``(slot,
+        finished)`` per item, input order.
+
+        Per item: the prompt is bucketed over the FULL bucket ladder and
+        ``max_new`` is clamped to the remaining cache room (mirroring
+        ``InferenceEngine._clamp_max_new``) — the prompt is never cut to
+        make room for generation. Only a prompt over the largest bucket
+        truncates, loudly (continuous slots are fixed-length; route such
+        prompts through ``InferenceEngine``'s chunked prefill instead).
+        Draws stay (seed, position)-keyed per row, so admission grouping
+        never changes what a request samples.
+
+        Failure isolation: a failed CHUNK fails only its own items — their
+        result entries are the exception instance (callers re-raise or
+        deliver per item); earlier chunks' admissions stand.
+        ``EngineStateLost`` is the exception to that: the reset wiped every
+        slot, so it propagates out of the whole call."""
         free = self.free_slots()
-        assert free, "admit() without a free slot"
-        row = free[0]
-        S = bucket_len(max(len(prompt), 1), self.buckets)
-        max_new = max(1, min(max_new, self.T - S))
-        p = list(prompt)[-S:]
-        if len(prompt) > S:
-            logger.warning(
-                "continuous-batch prompt of %d tokens exceeds the largest "
-                "bucket %d; left-truncating", len(prompt), S,
-            )
-        tokens = np.full((1, S), self.pad_id, np.int32)
-        mask = np.zeros((1, S), np.int32)
-        tokens[0, S - len(p):] = p
-        mask[0, S - len(p):] = 1
+        assert len(items) <= len(free), "admit_many() without enough free slots"
 
-        if seed is not None:
-            row_key = jax.random.PRNGKey(seed)
-        else:
-            self._rng, row_key = jax.random.split(self._rng)
-        # position-indexed draw: the first sampled token sits at position
-        # len(p); decode steps continue the same fold sequence
-        row_cache, tok0, row_start = self._get("prefill", S)(
-            self.params, self._put(tokens), self._put(mask),
-            self._put(jax.random.fold_in(row_key, len(p))),
+        prepared = []  # (item_idx, rid, S, p, max_new_c, row_key)
+        for i, (rid, prompt, max_new, seed) in enumerate(items):
+            S = bucket_len(max(len(prompt), 1), self.buckets)
+            max_new_c = max(1, min(max_new, self.T - S))
+            p = list(prompt)[-S:]
+            if len(prompt) > S:
+                logger.warning(
+                    "continuous-batch prompt of %d tokens exceeds the largest "
+                    "bucket %d; left-truncating", len(prompt), S,
+                )
+            if seed is not None:
+                row_key = jax.random.PRNGKey(seed)
+            else:
+                self._rng, row_key = jax.random.split(self._rng)
+            prepared.append((i, rid, S, p, max_new_c, row_key))
+
+        by_bucket: Dict[int, List] = {}
+        for entry in prepared:
+            by_bucket.setdefault(entry[2], []).append(entry)
+
+        results: List = [None] * len(items)
+        free_iter = iter(free)
+        for S, group in by_bucket.items():
+            pos = 0
+            while pos < len(group):
+                # pow2 chunks keep the executable ladder warmup-friendly
+                n = 1
+                while n * 2 <= min(len(group) - pos, self.B):
+                    n *= 2
+                chunk = group[pos : pos + n]
+                pos += n
+                rows = [next(free_iter) for _ in chunk]
+                try:
+                    self._admit_chunk(S, chunk, rows, results)
+                except EngineStateLost:
+                    raise  # slots are gone for EVERYONE; callers must fail
+                except BaseException as e:  # noqa: BLE001 — per-chunk isolation
+                    for i, _, _, _, _, _ in chunk:
+                        results[i] = e
+        return results
+
+    def _admit_chunk(self, S: int, chunk, rows: List[int], results: List):
+        """One batched prefill + insert + first-token fetch for ``chunk``."""
+        n = len(chunk)
+        tokens = np.full((n, S), self.pad_id, np.int32)
+        mask = np.zeros((n, S), np.int32)
+        folded_keys, base_keys = [], []
+        for r, (_, _, _, p, _, row_key) in enumerate(chunk):
+            tokens[r, S - len(p):] = p
+            mask[r, S - len(p):] = 1
+            # position-indexed draw: the first sampled token sits at position
+            # len(p); decode steps continue the same fold sequence. Keys STAY
+            # on device — fetching them here would put one host round-trip
+            # per request back on the admission path the batching removed
+            folded_keys.append(jax.random.fold_in(row_key, len(p)))
+            base_keys.append(row_key)
+        folded = jnp.stack(folded_keys)
+        row_keys = jnp.stack(base_keys)
+
+        row_cache, tok0s, row_starts = self._get("prefill", S, n)(
+            self.params, self._put(tokens), self._put(mask), self._put(folded)
         )
-        tok0 = int(tok0)
-        self.stats.generate_calls += 1
-        self.stats.prefill_tokens += len(p)
-        if tok0 in self.config.eos_token_ids or max_new <= 1:
-            out = [] if tok0 in self.config.eos_token_ids else [tok0]
-            self.stats.decode_tokens += len(out)
-            return row, out
-
         try:
+            # insert dispatches BEFORE the tok0 fetch: the splice runs on
+            # device while the first tokens cross the host link
             (self._cache, self._kv_start, self._kv_len,
-             self._last_tok, self._active, self._rng_keys) = self._get("insert", S)(
+             self._last_tok, self._active, self._rng_keys) = self._get("insert", S, n)(
                 self._cache, row_cache,
                 self._kv_start, self._kv_len, self._last_tok, self._active,
-                self._rng_keys, self._put(jnp.int32(row)), row_start,
-                self._put(jnp.int32(tok0)), self._put(row_key),
+                self._rng_keys, self._put(np.asarray(rows, np.int32)),
+                row_starts, tok0s, self._put(row_keys),
             )
         except BaseException as e:  # noqa: BLE001
             # insert donates the engine's cache/state buffers: a failure
@@ -478,12 +564,32 @@ class ContinuousEngine:
             # "Array has been deleted" while /healthz stays green
             self.reset()
             raise EngineStateLost("insert failed; engine state reset") from e
-        self.slots[row] = _Slot(
-            request_id=request_id, tokens=[tok0], remaining=max_new - 1,
-            active=True,
-        )
-        self.stats.decode_tokens += 1  # tok0, sampled at prefill
-        return row, None
+
+        tok0_h = np.asarray(tok0s)  # ONE fetch for the whole chunk
+        deactivate = []
+        for r, (i, rid, _, p, max_new_c, _) in enumerate(chunk):
+            tok0 = int(tok0_h[r])
+            row = rows[r]
+            self.stats.generate_calls += 1
+            self.stats.prefill_tokens += len(p)
+            if tok0 in self.config.eos_token_ids or max_new_c <= 1:
+                # finished at its very first token: the slot was spliced
+                # active by the batched insert — release it on device too
+                out = [] if tok0 in self.config.eos_token_ids else [tok0]
+                self.stats.decode_tokens += len(out)
+                deactivate.append(row)
+                results[i] = (row, out)
+                continue
+            self.slots[row] = _Slot(
+                request_id=rid, tokens=[tok0], remaining=max_new_c - 1,
+                active=True,
+            )
+            self.stats.decode_tokens += 1  # tok0, sampled at prefill
+            results[i] = (row, None)
+        if deactivate:
+            m = np.ones(self.B, bool)
+            m[deactivate] = False
+            self._active = self._active & self._put(jnp.asarray(m))
 
     def step(self) -> List[Tuple[int, List[int]]]:
         """``decode_sync_steps`` decode steps for every active slot in one
@@ -636,22 +742,44 @@ class ContinuousScheduler:
             while item is not None:  # admit everything that fits right now
                 if self._stop.is_set():
                     return item  # un-acked: the finally drain fails it
+                free = eng.free_slots()
+                if not free:
+                    # no room: decode until a slot frees, then admit
+                    self._safe_step(waiting)
+                    continue
+                # GROUP admission: drain whatever else is already queued up
+                # to the free-slot count — the engine batches same-bucket
+                # prefills and fetches all first tokens in one round-trip
+                batch = [item]
+                while len(batch) < len(free):
+                    try:
+                        nxt = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        break
+                    batch.append(nxt)
                 try:
-                    if not eng.free_slots():
-                        # no room: decode until a slot frees, then admit
-                        self._safe_step(waiting)
-                        continue
-                    _, finished = eng.admit(
-                        item.request_id, item.prompt, item.max_new, item.seed
+                    admitted = eng.admit_many(
+                        [(b.request_id, b.prompt, b.max_new, b.seed) for b in batch]
                     )
-                    if finished is not None:
-                        item.result = finished
-                        item.done.set()
-                    else:
-                        waiting[item.request_id] = item
-                except BaseException as e:  # noqa: BLE001 — deliver to waiter
-                    item.error = e
-                    item.done.set()
+                    for b, res in zip(batch, admitted):
+                        if isinstance(res, BaseException):
+                            # per-chunk failure: only ITS items fail; other
+                            # chunks' admissions stand and keep decoding
+                            b.error = res
+                            b.done.set()
+                            continue
+                        _, finished = res
+                        if finished is not None:
+                            b.result = finished
+                            b.done.set()
+                        else:
+                            waiting[b.request_id] = b
+                except BaseException as e:  # noqa: BLE001 — deliver to waiters
+                    for b in batch:
+                        b.error = e
+                        b.done.set()
                     if isinstance(e, EngineStateLost):
                         # the reset wiped every in-flight slot: their
                         # requests can never complete — fail them now

@@ -362,8 +362,18 @@ class RagService:
         # slots or coalescing wrapper around self.engine); self.engine alone
         # only when no scheduler exists
         serving_engine = self.scheduler.engine if self.scheduler is not None else self.engine
+        from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine
+
+        # the continuous engine's warmup batch_sizes size its ADMISSION-
+        # GROUP ladder: warm it to the slot count, or the first concurrent
+        # burst pays per-(bucket, group) compiles mid-request after
+        # /healthz already reports ready
+        warm_bs = (
+            (serving_engine.B,)
+            if isinstance(serving_engine, ContinuousEngine) else (1,)
+        )
         serving_engine.warmup(
-            batch_sizes=(1,), buckets=serving_engine.engine_config.prompt_buckets
+            batch_sizes=warm_bs, buckets=serving_engine.engine_config.prompt_buckets
         )
         from rag_llm_k8s_tpu.engine.batching import BatchScheduler
 
@@ -509,12 +519,18 @@ class WsgiApp:
             prefill_tokens=sum(e.stats.prefill_tokens for e in engines.values()),
             decode_tokens=sum(e.stats.decode_tokens for e in engines.values()),
             generate_calls=sum(e.stats.generate_calls for e in engines.values()),
+            spec_verify_steps=sum(
+                getattr(e.stats, "spec_verify_steps", 0) for e in engines.values()
+            ),
         )
         snap.update(
             {
                 "engine_generate_calls": stats.generate_calls,
                 "engine_prefill_tokens": stats.prefill_tokens,
                 "engine_decode_tokens": stats.decode_tokens,
+                # speculative decoding: decode_tokens / spec_verify_steps
+                # over a greedy-serving window = measured acceptance
+                "engine_spec_verify_steps": stats.spec_verify_steps,
                 "index_vectors": self.service.store.ntotal,
             }
         )

@@ -467,3 +467,143 @@ class TestMultiStepSync:
         assert outs[1] == outs[4]
         assert len(outs[1][1]) < 8, "EOS never fired — the fixture is vacuous"
         assert outs[1][1] == stream[:len(outs[1][1])]
+
+
+class TestBatchedAdmission:
+    """admit_many: a group of queued requests prefills together (one batched
+    forward per bucket chunk, one first-token fetch) — results must be
+    identical to admitting each request alone."""
+
+    def test_group_equals_solo_admission(self, setup):
+        cfg, params, oracle = setup
+        prompts = [[3, 17, 42, 7, 99], [5, 5, 8], [11] * 12, [2, 9]]
+        want = {i: oracle.generate([p])[0] for i, p in enumerate(prompts)}
+        eng = make_engine(cfg, params)
+        outs = eng.admit_many(
+            [(i, p, GREEDY.max_new_tokens, None) for i, p in enumerate(prompts)]
+        )
+        results = {i: fin for (i, p), (_, fin) in zip(enumerate(prompts), outs) if fin}
+        for _ in range(200):
+            for rid, toks in eng.step():
+                results[rid] = toks
+            if not eng.has_active():
+                break
+        assert results == want
+
+    def test_mixed_buckets_in_one_group(self, setup):
+        """Requests landing in different buckets split into per-bucket
+        chunks but still admit in one call."""
+        cfg, params, oracle = setup
+        prompts = [[3] * 4, [7] * 20, [9] * 5, [4] * 30]  # buckets 16 and 32
+        want = {i: oracle.generate([p])[0] for i, p in enumerate(prompts)}
+        eng = make_engine(cfg, params)
+        eng.admit_many([(i, p, GREEDY.max_new_tokens, None) for i, p in enumerate(prompts)])
+        results = {}
+        for _ in range(200):
+            for rid, toks in eng.step():
+                results[rid] = toks
+            if not eng.has_active():
+                break
+        assert results == want
+
+    def test_seeded_draws_independent_of_grouping(self, setup):
+        cfg, params, _ = setup
+        sampling = SamplingConfig(do_sample=True, temperature=0.8, top_p=0.9,
+                                  max_new_tokens=6, seed=0)
+        p1, p2 = [3, 17, 42], [5, 9, 2, 7]
+
+        def run(grouped):
+            eng = ContinuousEngine(cfg, params, sampling=sampling,
+                                   engine_config=ENG_CFG, dtypes=FP32)
+            if grouped:
+                eng.admit_many([(1, p1, 6, 11), (2, p2, 6, 22)])
+            else:
+                eng.admit(1, p1, 6, seed=11)
+                eng.admit(2, p2, 6, seed=22)
+            results = {}
+            for _ in range(100):
+                for rid, toks in eng.step():
+                    results[rid] = toks
+                if not eng.has_active():
+                    break
+            return results
+
+        assert run(True) == run(False)
+
+    def test_early_eos_in_group_frees_slot(self, setup):
+        """A request whose FIRST token is EOS finishes inside the group and
+        its slot is immediately reusable."""
+        cfg, params, oracle = setup
+        import dataclasses
+        p_live, p_dead = [5, 5, 8], [3, 17, 42, 7, 99]
+        first = oracle.generate([p_dead], max_new_tokens=1)[0][0]
+        cfg_eos = dataclasses.replace(cfg, eos_token_ids=(first,))
+        oracle2 = InferenceEngine(cfg_eos, params, sampling=GREEDY,
+                                  engine_config=ENG_CFG, dtypes=FP32)
+        want_live = oracle2.generate([p_live])[0]
+        eng = ContinuousEngine(cfg_eos, params, sampling=GREEDY,
+                               engine_config=ENG_CFG, dtypes=FP32)
+        outs = eng.admit_many([(1, p_dead, 8, None), (2, p_live, 8, None)])
+        assert outs[0][1] == []  # finished instantly at EOS
+        assert outs[1][1] is None
+        assert len(eng.free_slots()) == ENG_CFG.max_batch_size - 1
+        results = {}
+        for _ in range(100):
+            for rid, toks in eng.step():
+                results[rid] = toks
+            if not eng.has_active():
+                break
+        assert results == {2: want_live}
+
+    def test_scheduler_groups_concurrent_submits(self, setup):
+        """Concurrent scheduler submits land as grouped admissions (fewer
+        prefill fetches) with unchanged results."""
+        cfg, params, oracle = setup
+        prompts = [[3, 17, 42, 7, 99], [5, 5, 8], [11] * 12, [2, 9]]
+        want = [oracle.generate([p])[0] for p in prompts]
+        eng = make_engine(cfg, params)
+        sched = ContinuousScheduler(eng)
+        results = [None] * len(prompts)
+
+        def run(i):
+            results[i] = sched.submit(prompts[i], timeout=120)
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        sched.shutdown()
+        assert results == want
+
+    def test_chunk_failure_isolated_to_its_items(self, setup, monkeypatch):
+        """A failed admission chunk fails ONLY its own requests; other
+        chunks' admissions stand and decode to completion."""
+        cfg, params, oracle = setup
+        eng = make_engine(cfg, params)
+        p16, p32 = [3] * 4, [7] * 20  # buckets 16 and 32
+        want16 = oracle.generate([p16])[0]
+        real = eng._admit_chunk
+
+        def flaky(S, chunk, rows, results):
+            if S == 32:
+                raise RuntimeError("synthetic chunk failure")
+            return real(S, chunk, rows, results)
+
+        monkeypatch.setattr(eng, "_admit_chunk", flaky)
+        outs = eng.admit_many([(1, p16, GREEDY.max_new_tokens, None),
+                               (2, p32, GREEDY.max_new_tokens, None)])
+        assert not isinstance(outs[0], BaseException)
+        assert isinstance(outs[1], RuntimeError)
+        results = {}
+        for _ in range(100):
+            for rid, toks in eng.step():
+                results[rid] = toks
+            if not eng.has_active():
+                break
+        assert results == {1: want16}
+        # the single-admit wrapper re-raises per-item errors
+        monkeypatch.setattr(eng, "_admit_chunk", flaky)
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError, match="synthetic"):
+            eng.admit(3, p32, 4)
