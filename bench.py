@@ -96,10 +96,9 @@ QUERIES = [
 
 class WordHashTokenizer:
     """Deterministic stand-in tokenizer with realistic fertility (~1.3
-    tokens per English word — the measured Llama-3 rate on prose). The real
-    ``tokenizer.json`` files cannot be fetched here (zero egress);
-    tokenization cost is negligible next to embed/prefill/decode, so e2e
-    timings stay honest as long as token COUNTS are realistic."""
+    tokens per English word — the measured Llama-3 rate on prose). Kept for
+    micro-legs where tokenization is not what's being measured; the e2e
+    /query legs use the repo's REAL tokenizers (see ``_real_tokenizers``)."""
 
     def __init__(self, vocab_size: int, bos: int = 0):
         self.vocab_size = vocab_size
@@ -116,6 +115,34 @@ class WordHashTokenizer:
 
     def decode(self, ids, skip_special_tokens=True):
         return " ".join(f"tok{int(i)}" for i in ids)
+
+
+def _real_tokenizers():
+    """The repo's OWN tokenizers at true scale for the e2e legs (VERDICT r4
+    #3): the 128k-vocab byte-level BPE — C++ merge loop, id-exact vs the
+    Rust ``tokenizers`` wheel (tests/test_tokenizer_scale.py) — on the LLM
+    side, and the 250k-piece Unigram on the encoder side. The real
+    Llama-3/bge-m3 ``tokenizer.json`` files cannot be fetched here (zero
+    egress); these fixtures are TRAINED at the same scale, so both the
+    measured tokenize cost and the token counts carry real fertility.
+    Generates the fixtures when absent (tests/fixtures/gen_tokenizers.py).
+    """
+    import subprocess
+    import sys
+
+    from rag_llm_k8s_tpu.tokenizer import load_tokenizer
+
+    scale_dir = os.path.join(REPO, "tests", "fixtures", "tokenizers_scale")
+    bpe = os.path.join(scale_dir, "bpe_128k.json")
+    uni = os.path.join(scale_dir, "unigram_250k.json")
+    if not (os.path.exists(bpe) and os.path.exists(uni)):
+        subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tests", "fixtures", "gen_tokenizers.py"),
+             "--scale"],
+            check=True, timeout=600,
+        )
+    return load_tokenizer(bpe), load_tokenizer(uni)
 
 
 def _synthetic_pdf(n_words: int = 4000) -> bytes:
@@ -206,11 +233,13 @@ def measure_query_e2e() -> dict:
             jax.eval_shape(lambda: init_encoder_params(jax.random.PRNGKey(1), enc_cfg, dtypes))
         ),
         dtypes=dtypes,
-        length_buckets=(128, 2048),  # queries hit 128; 1000-word chunks hit 2048
+        # queries hit 128; 1000-word chunks (~1.4k Unigram pieces) hit the
+        # 1536 snug bucket, 2048 covers the heavier-fertility tail
+        length_buckets=(128, 1536, 2048),
         max_batch=8,
     )
     store = VectorStore(dim=enc_cfg.embed_dim)
-    enc_tok = WordHashTokenizer(enc_cfg.vocab_size)
+    llm_tok, enc_tok = _real_tokenizers()
 
     def make_params(llama_cfg, weight_quant: str):
         shapes = jax.eval_shape(
@@ -223,6 +252,103 @@ def measure_query_e2e() -> dict:
             shapes = jax.eval_shape(quantize_llama_params, shapes)
         return zeros_like_tree(shapes)
 
+    def make_params_8b_behavioral(llama_cfg):
+        """Synthetic Llama-3.1-8B int8 params with nontrivial BEHAVIOR,
+        generated ON DEVICE (an 8 GiB host transfer through this harness's
+        tunnel is a non-starter; jax.random on-chip is ~free).
+
+        Timing-wise this tree is identical to the zero tree — decode cost
+        is shape/dtype-bound. Behavior-wise it matters for ONE measurement:
+        speculative-decoding acceptance. A zero/flat model samples
+        UNIFORMLY over 128,256 tokens — an output entropy (~17 bits/step)
+        no served LLM operates at, which would force acceptance to 1/V ≈ 0
+        and make the spec-on e2e leg meaningless. So: random int8 kernels
+        at proper init scale (per-channel qscale = 1/(127·sqrt(fan_in))),
+        random bf16 embedding, ones norms — a random-init transformer,
+        whose greedy dynamics fall into repeat cycles (the honest
+        partial-acceptance middle case, VERDICT r3/r4) — and then the
+        lm_head scale is CALIBRATED (one 4 MB logits fetch + host-side
+        bisection; logits are linear in that scale) so the mean top-1
+        probability at the serving temperature lands at ~0.6, the peakedness
+        regime trained LLMs actually serve in (greedy-decodable text ⇒
+        top-1 typically 0.5–0.8 on prose). Acceptance is then MEASURED from
+        the run's engine counters and reported, never assumed."""
+        import jax.numpy as jnp
+
+        from rag_llm_k8s_tpu.models.llama import LlamaModel, make_kv_cache
+
+        shapes = jax.eval_shape(
+            quantize_llama_params,
+            jax.eval_shape(lambda: init_llama_params(jax.random.PRNGKey(0), llama_cfg, dtypes)),
+        )
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+
+        from rag_llm_k8s_tpu.models.llama import synth_leaf_kind
+
+        def gen_leaf(path, s, key):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            kind = synth_leaf_kind(name, s.dtype, s.ndim)
+            if kind == "kernel_q":
+                # int8 directly: an int32 intermediate on the [32,4096,14336]
+                # leaves would transiently cost ~7.5 GiB of the 16 GiB chip.
+                # maxval 127 (not 128): the bound is cast to int8, and 128
+                # would overflow to -128, degenerating the range to a
+                # CONSTANT — flat logits and a meaningless model
+                return jax.random.randint(key, s.shape, -126, 127, jnp.int8)
+            if kind == "quant_scale":
+                # per-output-channel scale: dequant weight std ≈
+                # (73/127)/sqrt(fan_in) ≈ 0.57/sqrt(fan_in) — standard
+                # init. fan_in is the CONTRACTED dim of the matching
+                # kernel: intermediate_size for the MLP down-projection,
+                # hidden_size everywhere else (wq/wk/wv/wo/w_gate/w_up/
+                # lm_head all contract over hidden)
+                parent = path[-2].key if len(path) > 1 and hasattr(path[-2], "key") else ""
+                fan_in = (
+                    llama_cfg.intermediate_size
+                    if parent == "w_down" else llama_cfg.hidden_size
+                )
+                return jnp.full(s.shape, 1.0 / (127.0 * math.sqrt(fan_in)), s.dtype)
+            if kind == "norm":
+                return jnp.ones(s.shape, s.dtype)  # RMSNorm weights
+            # bf16 embedding table
+            return (jax.random.normal(key, s.shape, jnp.float32) * 0.02).astype(s.dtype)
+
+        keys = jax.random.split(jax.random.PRNGKey(7), len(leaves))
+        params = jax.tree_util.tree_unflatten(
+            treedef, [gen_leaf(p, s, k) for (p, s), k in zip(leaves, keys)]
+        )
+
+        # --- calibrate output peakedness at the serving temperature ---
+        model = LlamaModel(llama_cfg, dtypes, attn_impl="xla", quantized=True)
+        S = 16
+        cache = make_kv_cache(llama_cfg, 1, 128, dtypes.compute_dtype)
+        toks = jax.random.randint(jax.random.PRNGKey(9), (1, S), 5, 50_000, jnp.int32)
+        pos = jnp.arange(S, dtype=jnp.int32)[None, :]
+        logits, _ = jax.jit(
+            lambda p, t: model.apply(
+                {"params": p}, t, pos, cache,
+                jnp.zeros((1,), jnp.int32), jnp.full((1,), S, jnp.int32), jnp.int32(0),
+            )
+        )(params, toks)
+        import numpy as np
+
+        lg = np.asarray(logits[0, S // 2:], np.float64)  # [S/2, V]
+        lg -= lg.max(axis=-1, keepdims=True)
+        temp = SamplingConfig().temperature
+
+        def top1(alpha: float) -> float:
+            z = lg * (alpha / temp)
+            p = np.exp(z - np.log(np.exp(z).sum(axis=-1, keepdims=True)))
+            return float(p.max(axis=-1).mean())
+
+        lo, hi = 1.0, 1e4
+        for _ in range(40):
+            mid = math.sqrt(lo * hi)
+            lo, hi = (lo, mid) if top1(mid) > 0.6 else (mid, hi)
+        alpha = math.sqrt(lo * hi)
+        params["lm_head_scale"] = params["lm_head_scale"] * jnp.float32(alpha)
+        return params, round(alpha, 2), round(top1(alpha), 3)
+
     def run_mode(
         llama_cfg,
         params,
@@ -231,12 +357,14 @@ def measure_query_e2e() -> dict:
         concurrency: int = 0,
         kv_quant: str = "bf16",
         n_queries: int = len(QUERIES),
+        speculative: str | None = None,
     ):
         app_cfg = AppConfig(model=llama_cfg, encoder=enc_cfg)
-        tok = WordHashTokenizer(llama_cfg.vocab_size, bos=llama_cfg.bos_token_id)
+        tok = llm_tok  # the repo's C++ BPE at 128k vocab (VERDICT r4 #3)
         # one 4096 bucket: the reference's full 3×1000-word context (~4k
         # tokens) fits without shrinking, so the measured prefill is the
         # real RAG prompt
+        ec_kw = {} if speculative is None else {"speculative": speculative}
         engine = InferenceEngine(
             llama_cfg,
             params,
@@ -246,6 +374,7 @@ def measure_query_e2e() -> dict:
                 max_batch_size=max(4, concurrency),
                 weight_quant=weight_quant,
                 kv_quant=kv_quant,
+                **ec_kw,
             ),
             dtypes=dtypes,
         )
@@ -364,7 +493,7 @@ def measure_query_e2e() -> dict:
                 "stages": burst_stages,
                 "sustained_stages": stages,
                 "sustained_p50": sustained[len(sustained) // 2],
-            }, None
+            }, None, _spec_snapshot(engine)
 
         for q in jobs:
             t0 = time.monotonic()
@@ -376,7 +505,17 @@ def measure_query_e2e() -> dict:
                 stages[k].append(body["timings"][k])
         service.shutdown()
         lat_ms.sort()
-        return lat_ms, stages, ingest_s
+        return lat_ms, stages, ingest_s, _spec_snapshot(engine)
+
+    def _spec_snapshot(engine) -> dict:
+        """Measured speculative acceptance from the run's own counters —
+        the number VERDICT r4 asked for (engine_spec_verify_steps)."""
+        v = engine.stats.spec_verify_steps
+        return {
+            "verify_steps": v,
+            "emitted": engine.stats.spec_emitted_tokens,
+            "tokens_per_verify": round(engine.stats.spec_emitted_tokens / v, 2) if v else None,
+        }
 
     def stage_means(stages) -> dict:
         return {
@@ -385,21 +524,30 @@ def measure_query_e2e() -> dict:
 
     cfg_1b = LlamaConfig.llama_3_2_1b()
     params_1b = make_params(cfg_1b, "bf16")
-    lat_ms, stages, ingest_s = run_mode(cfg_1b, params_1b, "bf16", ingest=True)
+    lat_ms, stages, ingest_s, _ = run_mode(cfg_1b, params_1b, "bf16", ingest=True)
     params_1b_q = make_params(cfg_1b, "int8")
-    lat_int8, _, _ = run_mode(cfg_1b, params_1b_q, "int8", ingest=False)
-    lat_load, load_info, _ = run_mode(
+    lat_int8, _, _, _ = run_mode(cfg_1b, params_1b_q, "int8", ingest=False)
+    lat_load, load_info, _, _ = run_mode(
         cfg_1b, params_1b, "bf16", ingest=False, concurrency=8
     )
     del params_1b, params_1b_q
 
     # ---- flagship: Llama-3.1-8B int8 weights + int8 KV, same WSGI path ----
+    # Behavioral synthetic weights (calibrated output peakedness — see
+    # make_params_8b_behavioral): the HEADLINE leg serves with the default
+    # engine config (speculative="auto" — rejection-sampled verification at
+    # the reference's 0.7/0.9 budget), and a spec-off A/B isolates what
+    # speculation buys at identical weights/shapes.
     cfg_8b = LlamaConfig.llama_3_1_8b()
-    params_8b = make_params(cfg_8b, "int8")
-    lat_8b, stages_8b, _ = run_mode(
+    params_8b, alpha_8b, top1_8b = make_params_8b_behavioral(cfg_8b)
+    lat_8b, stages_8b, _, spec_8b = run_mode(
         cfg_8b, params_8b, "int8", ingest=False, kv_quant="int8", n_queries=12
     )
-    lat_8b_load, load_8b, _ = run_mode(
+    lat_8b_off, _, _, _ = run_mode(
+        cfg_8b, params_8b, "int8", ingest=False, kv_quant="int8",
+        n_queries=6, speculative="off",
+    )
+    lat_8b_load, load_8b, _, _ = run_mode(
         cfg_8b, params_8b, "int8", ingest=False, kv_quant="int8", concurrency=8
     )
     del params_8b
@@ -449,6 +597,16 @@ def measure_query_e2e() -> dict:
         ),
         "query_p50_8b_adj_ms": round(lat_8b[len(lat_8b) // 2] - adj, 1),
         "query_8b_stage_ms": stage_means(stages_8b),
+        # speculative verification measured IN the headline 8B run
+        # (VERDICT r4 #1c): emitted/verify from the engine's own counters,
+        # plus the spec-off A/B at identical weights and the behavioral-
+        # weights calibration (alpha = lm_head scale factor; top1 = mean
+        # top-1 prob at T=0.7 after calibration)
+        "query_8b_tokens_per_verify": spec_8b["tokens_per_verify"],
+        "query_8b_spec_verify_steps": spec_8b["verify_steps"],
+        "query_p50_8b_nospec_ms": round(lat_8b_off[len(lat_8b_off) // 2], 1),
+        "query_8b_logit_alpha": alpha_8b,
+        "query_8b_top1_prob": top1_8b,
         "query_qps_8b_load": round(load_8b["qps"], 2),
         "query_p50_8b_load_ms": round(lat_8b_load[len(lat_8b_load) // 2], 1),
         "query_p50_8b_sustained_ms": round(load_8b["sustained_p50"], 1),
@@ -482,6 +640,7 @@ def _decode_tok_per_s(
             max_batch_size=batch,
             weight_quant=weight_quant,
             kv_quant=kv_quant,
+            speculative="off",  # this leg measures the VANILLA decode loop
         ),
         dtypes=DTypePolicy(),
     )
@@ -582,6 +741,77 @@ def measure_longctx() -> dict:
     }
 
 
+def measure_prefill() -> dict:
+    """Prefill throughput at the 4096-token bucket — the flash-attention
+    kernel path, the other half of every query's device time (decode, kNN
+    and e2e are numbered; VERDICT r4 #7 asked for this one). B=1 (the solo
+    /query prefill) and B=8 (the coalesced burst). Timing: M dispatches of
+    the jitted prefill forward (params as args), one blocking wait —
+    device time, with an MFU estimate against the v5e's ~197 bf16 TFLOP/s.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
+    from rag_llm_k8s_tpu.models.llama import (
+        LlamaModel,
+        init_llama_params,
+        make_kv_cache,
+    )
+
+    config = LlamaConfig.llama_3_2_1b()
+    dtypes = DTypePolicy()
+    shapes = jax.eval_shape(
+        lambda: init_llama_params(jax.random.PRNGKey(0), config, dtypes)
+    )
+    params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    model = LlamaModel(config, dtypes, attn_impl="auto")
+    S = 4096
+    T = -(-S // 128) * 128
+    # matmul params only: the tied embedding is gather-only during prefill
+    # (the lm_head matmul runs on ONE position under last_logit_only) —
+    # counting it would inflate MFU ~27% at 1B
+    n_params = sum(
+        int(math.prod(s.shape))
+        for path, s in jax.tree_util.tree_flatten_with_path(shapes)[0]
+        if "embedding" not in str(path[-1])
+    )
+    d_model = config.num_heads * config.head_dim
+    out = {}
+    for B in (1, 8):
+        cache = make_kv_cache(config, B, T, dtypes.compute_dtype)
+        toks = jnp.ones((B, S), jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+        def fwd(params, toks, pos, cache):
+            logits, _ = model.apply(
+                {"params": params}, toks, pos, cache,
+                jnp.zeros((B,), jnp.int32), jnp.full((B,), S, jnp.int32),
+                jnp.int32(0), last_logit_only=True,
+            )
+            return logits
+
+        fn = jax.jit(fwd)
+        jax.block_until_ready(fn(params, toks, pos, cache))  # compile
+        M = 4 if B == 1 else 2
+        best = 1e9
+        for _ in range(3):
+            t0 = time.monotonic()
+            for _ in range(M):
+                lg = fn(params, toks, pos, cache)
+            jax.block_until_ready(lg)
+            best = min(best, (time.monotonic() - t0) / M)
+        tok_per_s = B * S / best
+        # forward FLOPs: 2*N per token (weight matmuls; the embedding gather
+        # and final single-position logit matmul are negligible at B*S
+        # tokens) + causal attention 2*2*L*d_model*S^2/2 per sequence
+        flops = B * (2 * n_params * S + 2 * config.num_layers * d_model * S * S)
+        out[f"prefill_tok_per_s_b{B}"] = round(tok_per_s, 1)
+        out[f"prefill_mfu_b{B}"] = round(flops / best / 197e12, 3)
+    out["prefill_bucket"] = S
+    return out
+
+
 def measure_8b_int8() -> dict:
     """FULL-DEPTH Llama-3.1-8B — the reference's actual served model
     (download_model.py:5) — decoding on ONE chip via weight-only int8
@@ -669,7 +899,9 @@ def measure_speculative() -> dict:
     config = LlamaConfig.llama_3_2_1b()
     dtypes = DTypePolicy()
     G = SamplingConfig(do_sample=False, max_new_tokens=NEW_TOKENS)
-    ec = EngineConfig(prompt_buckets=(PROMPT_LEN,), max_batch_size=1)
+    ec = EngineConfig(
+        prompt_buckets=(PROMPT_LEN,), max_batch_size=1, speculative="off"
+    )
     ec_spec = dataclasses.replace(ec, speculative="prompt_lookup")
 
     def best_tok_per_s(eng, prompt):
@@ -701,7 +933,13 @@ def measure_speculative() -> dict:
         v_tps, v_out = best_tok_per_s(van, prompt)
         steps0 = spc.stats.spec_verify_steps
         s_tps, s_out = best_tok_per_s(spc, prompt)
-        assert s_out == v_out, f"speculative diverged from greedy ({case})"
+        # identity holds per-kernel-numerics: the verify forward (k+1-wide
+        # chunked kernel) and the 1-wide decode kernel can argmax-diverge on
+        # a bf16 logit near-tie, after which the streams legitimately differ
+        # — the ALGORITHM's exactness is proven in fp32 on CPU
+        # (tests/test_speculative.py); here record identity instead of
+        # crashing the bench on a numerics tie (ADVICE r4 #2)
+        out[f"spec_b1_{case}_identical"] = s_out == v_out
         steps = spc.stats.spec_verify_steps - steps0
         out[f"spec_b1_{case}_tok_per_s"] = round(s_tps, 1)
         out[f"spec_b1_{case}_vanilla_tok_per_s"] = round(v_tps, 1)
@@ -729,7 +967,8 @@ def measure_speculative() -> dict:
         key = "spec_8b_b1_all_accept" if label == "spec" else "spec_8b_b1_vanilla"
         out[f"{key}_tok_per_s"] = round(tps, 1)
         del eng
-    assert outs8["spec"] == outs8["vanilla"], "8B speculation diverged from greedy"
+    # recorded, not asserted: greedy identity is per-kernel-numerics (above)
+    out["spec_8b_identical"] = outs8["spec"] == outs8["vanilla"]
     del params8
     return out
 
@@ -819,7 +1058,9 @@ def measure_continuous() -> dict:
 
     engine = InferenceEngine(
         config, params, sampling=sampling,
-        engine_config=EngineConfig(prompt_buckets=(PROMPT_LEN,), max_batch_size=B),
+        engine_config=EngineConfig(
+            prompt_buckets=(PROMPT_LEN,), max_batch_size=B, speculative="off"
+        ),
         dtypes=dtypes,
     )
     engine.warmup(batch_sizes=(B,), buckets=(PROMPT_LEN,))
@@ -828,6 +1069,64 @@ def measure_continuous() -> dict:
     wall = drive(sched)
     sched.shutdown()
     out["coalesce_tok_per_s"] = round(NREQ * NEW_TOKENS / wall, 1)
+
+    # ---- DEVICE-ONLY continuous step rate (VERDICT r4 #5) ----
+    # The r4 steady-state numbers showed coalesce 7x ahead of the slot
+    # engine THROUGH THE TUNNEL (~130-200 ms per host fetch); the slot
+    # engine's claimed niche is directly-attached latency serving, so
+    # isolate its DEVICE step rate: chain N k-step scan dispatches with the
+    # state threaded executable-to-executable (no [k, B] token fetch, no
+    # admission), ONE blocking wait at the end. Compared against the
+    # one-shot engine's per-step time at equal batch (its whole generate is
+    # one device program, so its wall tok/s IS device rate).
+    def device_steps_per_s(batch: int, sync: int) -> float:
+        eng = ContinuousEngine(
+            config, params, sampling=sampling,
+            engine_config=EngineConfig(
+                prompt_buckets=(PROMPT_LEN,), max_batch_size=batch,
+                max_seq_len=PROMPT_LEN + NEW_TOKENS + 8, decode_sync_steps=sync,
+            ),
+            dtypes=dtypes,
+        )
+        eng.warmup(batch_sizes=(batch,))
+        eng.admit_many(
+            [(i, [config.bos_token_id] * PROMPT_LEN, NEW_TOKENS, None)
+             for i in range(batch)]
+        )
+        fn = eng._get("step", sync)
+        cache, kv_len, last_tok, active = (
+            eng._cache, eng._kv_len, eng._last_tok, eng._active
+        )
+        kv_start, rng = eng._kv_start, eng._rng_keys
+
+        def run_n(n, cache, kv_len, last_tok, active):
+            for _ in range(n):
+                cache, kv_len, last_tok, toks, _, active = fn(
+                    eng.params, cache, kv_start, kv_len, last_tok, active, rng
+                )
+            jax.block_until_ready(toks)
+            return cache, kv_len, last_tok, active
+
+        state = run_n(1, cache, kv_len, last_tok, active)  # settle pipeline
+        n_calls = max(1, (NEW_TOKENS - sync) // sync)
+        best = 1e9
+        for _ in range(3):
+            t0 = time.monotonic()
+            state = run_n(n_calls, *state)
+            best = min(best, time.monotonic() - t0)
+        del eng
+        return n_calls * sync / best
+
+    out["continuous_device_steps_per_s"] = {
+        "b8_sync1": round(device_steps_per_s(8, 1), 1),
+        "b8_sync16": round(device_steps_per_s(8, 16), 1),
+        "b64_sync16": round(device_steps_per_s(64, 16), 1),
+    }
+    # one-shot per-step rate at equal batch for the comparison
+    out["oneshot_steps_per_s"] = {
+        "b8": round(_decode_tok_per_s(config, params, 8, "bf16") / 8, 1),
+        "b64": round(_decode_tok_per_s(config, params, 64, "bf16") / 64, 1),
+    }
     return out
 
 
@@ -890,6 +1189,7 @@ def get_cpu_baseline() -> float:
 def main():
     baseline = get_cpu_baseline()
     tpu = measure_tpu()
+    pf = measure_prefill()
     b8 = measure_8b_int8()
     lc = measure_longctx()
     knn = measure_knn_scale()
@@ -909,6 +1209,7 @@ def main():
         "decode_int8_tok_per_s": {str(b): v for b, v in tpu["int8"].items()},
         "query_p50_target_ms": 2000,  # BASELINE.md north star: p50 < 2 s
     }
+    line.update(pf)
     line.update(b8)
     line.update(lc)
     line.update(knn)

@@ -301,19 +301,33 @@ class EngineConfig:
     # every decode step streams, and fits 8B weights on one 16 GB chip;
     # see models.llama.quantize_llama_params). Training always stays bf16.
     weight_quant: str = "bf16"
-    # speculative decoding for the one-shot engine's GREEDY batch-1 path
-    # (the single-request latency case): "prompt_lookup" proposes the
-    # spec_tokens tokens that followed the most recent in-context repeat of
-    # the trailing spec_ngram-gram (RAG answers quote their context, so
-    # repeats are common), verifies all of them in ONE forward — decode is
+    # speculative decoding for the one-shot engine's batch-1 path (the
+    # single-request latency case): "prompt_lookup" proposes the spec_tokens
+    # tokens that followed the most recent in-context repeat of the trailing
+    # spec_ngram-gram (RAG answers quote their context, so repeats are
+    # common), verifies all of them in ONE forward — decode is
     # weight-bandwidth-bound, so a k+1-wide verify step costs ~one decode
-    # step — and accepts the longest prefix that matches the model's own
-    # greedy choices. Output is token-IDENTICAL to vanilla greedy decode
-    # (tests/test_speculative.py); sampling or batch>1 requests fall back
-    # to the vanilla loop. Env: TPU_RAG_SPECULATIVE.
-    speculative: str = "off"  # "off" | "prompt_lookup"
+    # step. GREEDY requests accept the longest prefix matching the model's
+    # own argmax (output token-IDENTICAL to the vanilla loop); SAMPLED
+    # requests accept by rejection sampling against the draft (output
+    # distribution IDENTICAL to vanilla temperature/top-p sampling —
+    # tests/test_speculative.py). Batch>1 and chunked prompts fall back to
+    # the vanilla loop. The default "auto" additionally self-disables when
+    # MEASURED acceptance stays below spec_min_accept tokens/verify (a
+    # model/workload where lookup never hits should not pay the verify
+    # overhead), re-probing periodically; "off" is the escape hatch.
+    # Env: TPU_RAG_SPECULATIVE.
+    speculative: str = "auto"  # "off" | "prompt_lookup" | "auto"
     spec_ngram: int = 3
     spec_tokens: int = 7  # proposals per verify step (k+1 = 8 fed tokens)
+    # "auto" keeps speculating only while the acceptance EMA stays above
+    # this (tokens emitted per verify forward). Breakeven is the verify
+    # forward's cost in decode steps — MEASURED 1.39 at the 8B int8+kv8
+    # flagship point (the k+1=8-wide chunked verify vs the 1-wide decode
+    # step, round-5 A/B at acceptance 1.0: 56.6 vs 79.0 tok/s) — so the
+    # default sits just under it: marginal workloads keep probing, clear
+    # losers stop paying the overhead.
+    spec_min_accept: float = 1.35
     # continuous engine: decode steps executed per host sync. 1 = admit and
     # retire between every step (lowest admission latency). >1 runs k steps
     # as ONE device program (lax.scan) and fetches the [k, B] token plane
@@ -453,9 +467,10 @@ class AppConfig:
             sampling = dataclasses.replace(sampling, do_sample=flag == "1")
         if "TPU_RAG_SPECULATIVE" in env:
             spec = env["TPU_RAG_SPECULATIVE"]
-            if spec not in ("off", "prompt_lookup"):
+            if spec not in ("off", "prompt_lookup", "auto"):
                 raise ValueError(
-                    f"TPU_RAG_SPECULATIVE={spec!r}: expected 'off' or 'prompt_lookup'"
+                    f"TPU_RAG_SPECULATIVE={spec!r}: expected 'off', "
+                    "'prompt_lookup' or 'auto'"
                 )
             engine = dataclasses.replace(engine, speculative=spec)
         if "TPU_RAG_SYNC_STEPS" in env:

@@ -565,31 +565,44 @@ class ContinuousEngine:
             self.reset()
             raise EngineStateLost("insert failed; engine state reset") from e
 
-        tok0_h = np.asarray(tok0s)  # ONE fetch for the whole chunk
-        deactivate = []
-        for r, (i, rid, _, p, max_new_c, _) in enumerate(chunk):
-            tok0 = int(tok0_h[r])
-            row = rows[r]
-            self.stats.generate_calls += 1
-            self.stats.prefill_tokens += len(p)
-            if tok0 in self.config.eos_token_ids or max_new_c <= 1:
-                # finished at its very first token: the slot was spliced
-                # active by the batched insert — release it on device too
-                out = [] if tok0 in self.config.eos_token_ids else [tok0]
-                self.stats.decode_tokens += len(out)
-                deactivate.append(row)
-                results[i] = (row, out)
-                continue
-            self.slots[row] = _Slot(
-                request_id=rid, tokens=[tok0], remaining=max_new_c - 1,
-                active=True,
-            )
-            self.stats.decode_tokens += 1  # tok0, sampled at prefill
-            results[i] = (row, None)
-        if deactivate:
+        try:
+            tok0_h = np.asarray(tok0s)  # ONE fetch for the whole chunk
+            deactivate = []
+            for r, (i, rid, _, p, max_new_c, _) in enumerate(chunk):
+                tok0 = int(tok0_h[r])
+                row = rows[r]
+                self.stats.generate_calls += 1
+                self.stats.prefill_tokens += len(p)
+                if tok0 in self.config.eos_token_ids or max_new_c <= 1:
+                    # finished at its very first token: the slot was spliced
+                    # active by the batched insert — release it on device too
+                    out = [] if tok0 in self.config.eos_token_ids else [tok0]
+                    self.stats.decode_tokens += len(out)
+                    deactivate.append(row)
+                    results[i] = (row, out)
+                    continue
+                self.slots[row] = _Slot(
+                    request_id=rid, tokens=[tok0], remaining=max_new_c - 1,
+                    active=True,
+                )
+                self.stats.decode_tokens += 1  # tok0, sampled at prefill
+                results[i] = (row, None)
+            if deactivate:
+                m = np.ones(self.B, bool)
+                m[deactivate] = False
+                self._active = self._active & self._put(jnp.asarray(m))
+        except BaseException:  # noqa: BLE001 — release before isolation
+            # the insert already spliced these rows device-active; failing
+            # here (e.g. the tok0 fetch) would otherwise leave them decoding
+            # garbage every step with no host _Slot to ever retire them —
+            # deactivate the whole chunk's rows and drop any _Slot entries
+            # made above, THEN let admit_many's per-chunk isolation handle it
             m = np.ones(self.B, bool)
-            m[deactivate] = False
+            m[rows] = False
             self._active = self._active & self._put(jnp.asarray(m))
+            for row in rows:
+                self.slots[row] = _Slot()  # fresh inactive slot
+            raise
 
     def step(self) -> List[Tuple[int, List[int]]]:
         """``decode_sync_steps`` decode steps for every active slot in one

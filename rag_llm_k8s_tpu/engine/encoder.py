@@ -29,8 +29,13 @@ class EncoderRunner:
         params,
         dtypes: DTypePolicy = DTypePolicy(),
         mesh: Optional[MeshContext] = None,
-        length_buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048, 4096, 8192),
-        max_batch: int = 16,
+        # 1536/3072 snug buckets: the reference's 1000-word chunks tokenize
+        # to ~1.3-1.5k pieces — padding them to 2048 wastes a third of every
+        # (compute-bound) ingest forward
+        length_buckets: Sequence[int] = (
+            64, 128, 256, 512, 1024, 1536, 2048, 3072, 4096, 8192
+        ),
+        max_batch: int = 32,
         eos_id: Optional[int] = None,
     ):
         self.config = config
@@ -65,25 +70,41 @@ class EncoderRunner:
         return tokens, mask
 
     def encode(self, token_lists: Sequence[Sequence[int]]) -> np.ndarray:
-        """Token-id sequences → ``[N, hidden]`` fp32 unit vectors."""
+        """Token-id sequences → ``[N, hidden]`` fp32 unit vectors.
+
+        Two-phase: DISPATCH every bucketed group back-to-back (JAX dispatch
+        is async, so the device pipeline stays full and the host pads the
+        next group while the previous one computes), then fetch ALL results
+        in one device→host transfer. One fetch per call instead of one per
+        ``max_batch`` group — on a slow host link the per-group fetch was
+        ~40% of warm ingest time (round-4: ~13 ms of every chunk's 49 ms).
+        """
         if not token_lists:
             return np.zeros((0, self.config.hidden_size), np.float32)
         out = np.zeros((len(token_lists), self.config.hidden_size), np.float32)
         # group by length bucket to minimize padding waste
         order = sorted(range(len(token_lists)), key=lambda i: len(token_lists[i]))
+        pending = []  # (group, device_emb)
+        pad = self.config.pad_token_id
         for start in range(0, len(order), self.max_batch):
             group = order[start : start + self.max_batch]
             S = bucket_len(max(len(token_lists[i]) for i in group), self.length_buckets)
             B = next_pow2(len(group))
-            pad = self.config.pad_token_id
             tokens = np.full((B, S), pad, np.int32)
             mask = np.zeros((B, S), np.int32)
             for row, i in enumerate(group):
                 ids = truncate_keep_eos(token_lists[i], S, self.eos_id)
                 tokens[row, : len(ids)] = ids
                 mask[row, : len(ids)] = 1
-            emb = self._jit(self.params, jnp.asarray(tokens), jnp.asarray(mask))
-            emb = np.asarray(emb)
+            pending.append(
+                (group, self._jit(self.params, jnp.asarray(tokens), jnp.asarray(mask)))
+            )
+        # device-side concat → ONE host fetch for the whole call (group
+        # batch dims differ, but the hidden dim is shared)
+        stacked = np.asarray(jnp.concatenate([e for _, e in pending], axis=0))
+        off = 0
+        for group, e in pending:
             for row, i in enumerate(group):
-                out[i] = emb[row]
+                out[i] = stacked[off + row]
+            off += e.shape[0]
         return out

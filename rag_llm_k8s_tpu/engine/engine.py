@@ -42,7 +42,7 @@ from rag_llm_k8s_tpu.core.config import (
     SamplingConfig,
 )
 from rag_llm_k8s_tpu.core.mesh import MeshContext
-from rag_llm_k8s_tpu.engine.sampling import sample_token
+from rag_llm_k8s_tpu.engine.sampling import NEG_INF, _prepared_logits, sample_token
 from rag_llm_k8s_tpu.models.llama import (
     LlamaModel,
     make_kv_cache,
@@ -125,9 +125,12 @@ class EngineStats:
     prefill_tokens: int = 0
     decode_tokens: int = 0
     generate_calls: int = 0
-    # speculative decoding: verify forwards run (each emits >= 1 token);
-    # decode_tokens / spec_verify_steps over a spec run = tokens per step
+    # speculative decoding: verify forwards run (each emits >= 1 token) and
+    # tokens emitted by them; emitted / verify_steps = measured acceptance
+    # (tokens per verify forward, >= 1.0 — the counter VERDICT r4 asked the
+    # e2e bench to report)
     spec_verify_steps: int = 0
+    spec_emitted_tokens: int = 0
 
 
 class InferenceEngine:
@@ -153,19 +156,16 @@ class InferenceEngine:
             raise ValueError(
                 f"kv_quant={engine_config.kv_quant!r}: expected 'bf16' or 'int8'"
             )
-        if engine_config.speculative not in ("off", "prompt_lookup"):
+        if engine_config.speculative not in ("off", "prompt_lookup", "auto"):
             raise ValueError(
                 f"speculative={engine_config.speculative!r}: expected "
-                "'off' or 'prompt_lookup'"
+                "'off', 'prompt_lookup' or 'auto'"
             )
-        if engine_config.speculative == "prompt_lookup" and sampling.do_sample:
-            # the knob only serves greedy batch-1 requests: surface the
-            # no-op loudly instead of silently decoding vanilla forever
-            logger.warning(
-                "speculative='prompt_lookup' configured but sampling is "
-                "enabled (do_sample=True): speculation only serves GREEDY "
-                "requests — set TPU_RAG_DO_SAMPLE=0 for it to activate"
-            )
+        # adaptive speculation ("auto"): EMA of measured tokens-per-verify;
+        # when the workload/model gives ~1.0 (lookup never hits), stop paying
+        # the verify overhead, re-probing every _SPEC_REPROBE-th call
+        self._spec_ema: Optional[float] = None
+        self._spec_skips = 0
         self.params, fused = maybe_fuse_params(params, engine_config, mesh)
         self.params, quantized = maybe_quantize_params(self.params, engine_config)
         self.model = LlamaModel(
@@ -298,24 +298,40 @@ class InferenceEngine:
         )
 
     def _build_generate_spec(self, S: int, max_new: int):
-        """AOT-compile the SPECULATIVE greedy batch-1 generate executable
-        (``EngineConfig.speculative="prompt_lookup"``).
+        """AOT-compile the SPECULATIVE batch-1 generate executable
+        (``EngineConfig.speculative`` = "prompt_lookup"/"auto").
 
         Each loop iteration feeds ``k+1`` tokens — the pending last token
         plus the ``k`` tokens that followed the most recent in-context
         repeat of the trailing ``n``-gram — through the offset-causal
         chunked model (ONE forward ≈ one decode step's weight traffic),
-        then keeps the longest proposal prefix matching the model's own
-        greedy argmax plus the correction token. Rejected proposals cost
-        nothing to undo: the KV frontier simply doesn't advance over their
-        slots, and later iterations overwrite them (the same windowed-mask
-        machinery chunked prefill already relies on). Output is
-        token-identical to the vanilla greedy loop by construction: every
-        emitted token IS a greedy argmax given the accepted prefix.
+        then keeps the longest accepted proposal prefix plus one correction
+        token. Rejected proposals cost nothing to undo: the KV frontier
+        simply doesn't advance over their slots, and later iterations
+        overwrite them (the same windowed-mask machinery chunked prefill
+        already relies on).
+
+        Acceptance rule per position ``j`` with proposal ``x``:
+        - **greedy** (``do_sample=False``): accept iff ``x`` equals the
+          model's own argmax — output token-identical to the vanilla loop.
+        - **sampled** (``do_sample=True``): REJECTION SAMPLING against the
+          deterministic draft: accept with probability ``p_j(x)`` under the
+          temperature/top-p-filtered target distribution; on rejection emit
+          a draw from the residual (``p_j`` with ``x`` masked, renormalized
+          — for a point-mass draft the residual of ``max(p-q, 0)`` is
+          exactly that); on full acceptance emit a bonus draw from ``p_k``.
+          Marginally each emitted token is distributed exactly as one
+          vanilla sampling step given its prefix: ``P(x) = p(x)`` (accept)
+          and ``P(y≠x) = (1-p(x))·p(y)/(1-p(x)) = p(y)`` (reject) — the
+          emitted DISTRIBUTION equals vanilla 0.7/0.9 sampling
+          (tests/test_speculative.py::TestSampledDistribution), though the
+          stream for a pinned seed differs (different rng consumption).
         """
         cfg, dt = self.config, self.dtypes
         model = self.model
         mc = self.model_chunked
+        sampling = self.sampling
+        sampled = sampling.do_sample and sampling.temperature > 0.0
         n = max(1, self.engine_config.spec_ngram)
         k = max(1, self.engine_config.spec_tokens)
         # k extra cache slots: the LAST verify forward can start as late as
@@ -329,7 +345,7 @@ class InferenceEngine:
         pad_id = self.pad_id
         i32 = jnp.int32
 
-        def gen(params, tokens, pad_mask, rng):  # rng unused: greedy only
+        def gen(params, tokens, pad_mask, rng):
             cache = make_kv_cache(
                 cfg, 1, T, cache_dtype, quant=self.engine_config.kv_quant
             )
@@ -341,7 +357,8 @@ class InferenceEngine:
                 kv_start, jnp.full((1,), S, i32), i32(0),
                 last_logit_only=True,
             )
-            tok0 = jnp.argmax(logits[:, -1], axis=-1).astype(i32)  # [1]
+            rng, k0 = jax.random.split(rng)
+            tok0 = sample_token(k0, logits[:, -1], sampling)  # [1]
             done0 = _isin(tok0, eos_ids)[0]
             # out and hist carry k+1 slack slots: every scatter below then
             # uses UNIQUE per-lane indices (e + j / wi + 1 + j) — clipping
@@ -355,11 +372,11 @@ class InferenceEngine:
             hist0 = hist0.at[:, S].set(tok0)
 
             def cond(c):
-                e, _, _, done, _, _ = c
+                e, _, _, done, _, _, _ = c
                 return (e < max_new) & ~done
 
             def body(c):
-                e, cache, hist, done, out, iters = c
+                e, cache, hist, done, out, rng, iters = c
                 wi = (S + e - 1).astype(i32)  # slot of the pending token
                 row = hist[0]
                 last_tok = jax.lax.dynamic_slice(row, (wi,), (1,))  # [1]
@@ -385,11 +402,42 @@ class InferenceEngine:
                 logits, cache = mc.apply(
                     {"params": params}, fed, pos, cache, kv_start, kv_len, wi
                 )
-                g = jnp.argmax(logits[0], axis=-1).astype(i32)  # [k+1] greedy
-                # longest accepted proposal prefix, then the correction token
-                acc = jnp.cumprod((props == g[:k]).astype(i32))
-                m = jnp.sum(acc)
                 j_idx = jnp.arange(k + 1, dtype=i32)
+                if not sampled:
+                    # greedy: accept iff the proposal IS the argmax; position
+                    # m then carries the correction argmax — token-identical
+                    # to the vanilla greedy loop by construction
+                    g = jnp.argmax(logits[0], axis=-1).astype(i32)  # [k+1]
+                    acc = jnp.cumprod((props == g[:k]).astype(i32))
+                    m = jnp.sum(acc)
+                else:
+                    # rejection sampling vs the point-mass draft (docstring):
+                    # accept proposal x_j w.p. p_j(x_j); on rejection draw
+                    # from p_j with x_j masked (the normalized residual of
+                    # max(p - q, 0) for q = δ_x); on full acceptance draw the
+                    # bonus token from p_k. Emitted marginal == vanilla
+                    # sampling exactly, per position given its prefix.
+                    prepared = _prepared_logits(logits[0], sampling)  # [k+1, V]
+                    probs = jax.nn.softmax(prepared, axis=-1)
+                    rng, it_key = jax.random.split(rng)
+                    ku, kr = jax.random.split(it_key)
+                    p_prop = jnp.take_along_axis(
+                        probs[:k], props[:, None], axis=-1
+                    )[:, 0]  # [k]
+                    accept = jax.random.uniform(ku, (k,)) < p_prop
+                    acc = jnp.cumprod(accept.astype(i32))
+                    m = jnp.sum(acc)
+                    res = prepared[:k].at[jnp.arange(k), props].set(NEG_INF)
+                    rkeys = jax.random.split(kr, k + 1)
+                    r = jax.vmap(jax.random.categorical)(rkeys[:k], res)
+                    bonus = jax.random.categorical(rkeys[k], prepared[k])
+                    corr = jnp.where(
+                        m < k, r[jnp.minimum(m, k - 1)], bonus
+                    ).astype(i32)
+                    # accepted positions emit their proposal; position m the
+                    # correction/bonus draw (slots past m are never emitted)
+                    g = jnp.concatenate([props, bonus[None].astype(i32)])
+                    g = jnp.where(j_idx == m, corr, g)
                 is_eos = _isin(g, eos_ids)
                 eos_pos = jnp.min(jnp.where(is_eos & (j_idx <= m), j_idx, k + 1))
                 m_eff = jnp.minimum(jnp.minimum(m, eos_pos), max_new - e - 1)
@@ -403,11 +451,11 @@ class InferenceEngine:
                 done = done | (eos_pos <= m_eff)
                 return (
                     e + m_eff + 1, cache, hist_row[None], done, out_row[None],
-                    iters + 1,
+                    rng, iters + 1,
                 )
 
-            init = (i32(1), cache, hist0, done0, out0, i32(0))
-            _, _, _, _, out, iters = jax.lax.while_loop(cond, body, init)
+            init = (i32(1), cache, hist0, done0, out0, rng, i32(0))
+            _, _, _, _, out, _, iters = jax.lax.while_loop(cond, body, init)
             # iters = verify forwards run; the emitted-token count over it
             # is the measured acceptance rate (EngineStats.spec_verify_steps)
             return out[:, :max_new], iters
@@ -434,15 +482,40 @@ class InferenceEngine:
                 fn = self._compiled[key]
         return fn
 
+    _SPEC_EMA_DECAY = 0.7
+    _SPEC_REPROBE = 32
+
     def _spec_applicable(self, n_prompts: int, chunk) -> bool:
-        """Prompt-lookup speculation serves exactly the greedy batch-1
-        single-shot case; everything else falls back to the vanilla loop."""
-        return (
-            self.engine_config.speculative == "prompt_lookup"
-            and n_prompts == 1
-            and not self.sampling.do_sample
-            and chunk is None
-        )
+        """Prompt-lookup speculation serves the batch-1 single-shot case —
+        greedy (token-identical) and sampled (distribution-identical via
+        rejection sampling); batch > 1 and chunked prompts fall back to the
+        vanilla loop. Under ``speculative="auto"`` the engine additionally
+        disables itself when MEASURED acceptance stays below
+        ``spec_min_accept`` tokens/verify (a k+1-wide verify forward costs
+        ~1.4 decode steps measured at the 8B int8 flagship point — below
+        that, lookup is not paying for itself), re-probing every
+        ``_SPEC_REPROBE``-th eligible call in case the workload changed."""
+        mode = self.engine_config.speculative
+        if mode not in ("prompt_lookup", "auto") or n_prompts != 1 or chunk is not None:
+            return False
+        if mode == "auto":
+            with self._lock:
+                ema, skips = self._spec_ema, self._spec_skips
+                low = ema is not None and ema < self.engine_config.spec_min_accept
+                if low:
+                    self._spec_skips += 1
+            if low and (skips + 1) % self._SPEC_REPROBE != 0:
+                return False
+        return True
+
+    def _spec_record(self, emitted: int, iters: int):
+        """Fold one speculative call's measured acceptance into the EMA."""
+        acc = emitted / max(iters, 1)
+        with self._lock:
+            self.stats.spec_verify_steps += iters
+            self.stats.spec_emitted_tokens += emitted
+            d = self._SPEC_EMA_DECAY
+            self._spec_ema = acc if self._spec_ema is None else d * self._spec_ema + (1 - d) * acc
 
     # ------------------------------------------------------------------
     # host-side API
@@ -551,11 +624,10 @@ class InferenceEngine:
         spec = self._spec_applicable(len(prompts), chunk)
         fn = self._get_compiled(B, S, max_new, "spec" if spec else chunk)
         tokens_j, mask_j, rng_j = self._place_inputs(tokens, pad_mask, rng)
+        iters = 0
         if spec:
             out, iters = fn(self.params, tokens_j, mask_j, rng_j)
             out = np.asarray(out)
-            with self._lock:
-                self.stats.spec_verify_steps += int(iters)
         else:
             out = np.asarray(fn(self.params, tokens_j, mask_j, rng_j))
 
@@ -570,6 +642,13 @@ class InferenceEngine:
                 row.append(int(t))
             results.append(row)
             n_decode += len(row)
+        if spec and int(iters) > 0:
+            # tokens the VERIFY forwards emitted: answer tokens + the EOS
+            # that ended it (if any) MINUS tok0 (sampled at prefill, not by
+            # a verify); measured acceptance feeds the auto mode and the
+            # /metrics counters
+            emitted = len(results[0]) + (1 if len(results[0]) < max_new else 0) - 1
+            self._spec_record(max(emitted, 0), int(iters))
         with self._lock:
             self.stats.generate_calls += 1
             self.stats.prefill_tokens += int(pad_mask.sum())
@@ -601,7 +680,16 @@ class InferenceEngine:
             for s in buckets:
                 mb = self._bucket_batch(b)
                 mn = self._clamp_max_new(s, max_new)
-                if mb == 1 and self._spec_applicable(1, None):
+                # STATIC config decides what to warm — never the runtime
+                # acceptance EMA (_spec_applicable), which would skip the
+                # spec compile on a re-warm after a low-acceptance phase and
+                # push the full AOT compile into the next reprobed request
+                spec_mode = self.engine_config.speculative
+                if mb == 1 and spec_mode in ("prompt_lookup", "auto"):
                     self._get_compiled(1, s, mn, "spec")
+                    if spec_mode == "auto":
+                        # auto can fall back to the vanilla loop on measured
+                        # low acceptance — warm that executable too
+                        self._get_compiled(1, s, mn)
                 else:
                     self._get_compiled(mb, s, mn)

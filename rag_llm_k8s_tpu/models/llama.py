@@ -837,6 +837,26 @@ def quantize_llama_params(params: dict, donate: bool = False) -> dict:
     return quant
 
 
+def synth_leaf_kind(name: str, dtype, ndim: int) -> str:
+    """Classify a QUANTIZED-Llama param leaf for the synthetic weight
+    builders (bench.py's behavioral 8B tree, __graft_entry__'s tp-sharded
+    serving dry-run): ``"kernel_q"`` (int8 kernels), ``"quant_scale"``
+    (per-channel dequant scales), ``"norm"`` (RMSNorm weights — MUST stay
+    ~1), or ``"embedding"`` (the bf16 table). Quant scales match by EXACT
+    name: RMSNorm weights are ALSO called "scale" in the Flax tree, and a
+    substring match once flattened every norm to ~1e-4 and collapsed the
+    network to flat logits."""
+    import numpy as np
+
+    if np.dtype(dtype) == np.int8:
+        return "kernel_q"
+    if name in ("qscale", "lm_head_scale", "embedding_scale"):
+        return "quant_scale"
+    if ndim == 1 or "norm" in name:
+        return "norm"
+    return "embedding"
+
+
 def init_llama_params(
     rng: jax.Array,
     config: LlamaConfig,

@@ -522,15 +522,19 @@ class WsgiApp:
             spec_verify_steps=sum(
                 getattr(e.stats, "spec_verify_steps", 0) for e in engines.values()
             ),
+            spec_emitted_tokens=sum(
+                getattr(e.stats, "spec_emitted_tokens", 0) for e in engines.values()
+            ),
         )
         snap.update(
             {
                 "engine_generate_calls": stats.generate_calls,
                 "engine_prefill_tokens": stats.prefill_tokens,
                 "engine_decode_tokens": stats.decode_tokens,
-                # speculative decoding: decode_tokens / spec_verify_steps
-                # over a greedy-serving window = measured acceptance
+                # speculative decoding: spec_emitted_tokens /
+                # spec_verify_steps = measured acceptance (tokens/verify)
                 "engine_spec_verify_steps": stats.spec_verify_steps,
+                "engine_spec_emitted_tokens": stats.spec_emitted_tokens,
                 "index_vectors": self.service.store.ntotal,
             }
         )

@@ -132,14 +132,15 @@ def build_service():
     )
 
     if config.engine.batching == "continuous":
-        if config.engine.speculative != "off":
+        if config.engine.speculative == "prompt_lookup":
             # the slot-based engine has no speculative path; without this
-            # the knob would be silently inert behind the scheduler
+            # the EXPLICIT knob would be silently inert behind the scheduler
+            # (the default "auto" simply never engages here — no warning)
             logger.warning(
-                "TPU_RAG_SPECULATIVE is configured but TPU_RAG_BATCHING="
-                "'continuous' routes requests through the slot engine, "
-                "which does not speculate — use batching='coalesce' (the "
-                "default) for speculation to serve"
+                "TPU_RAG_SPECULATIVE='prompt_lookup' is configured but "
+                "TPU_RAG_BATCHING='continuous' routes requests through the "
+                "slot engine, which does not speculate — use "
+                "batching='coalesce' (the default) for speculation to serve"
             )
         from rag_llm_k8s_tpu.engine.continuous import (
             ContinuousEngine,

@@ -607,3 +607,40 @@ class TestBatchedAdmission:
         import pytest as _pytest
         with _pytest.raises(RuntimeError, match="synthetic"):
             eng.admit(3, p32, 4)
+
+
+class TestAdmitChunkFailureReleasesRows:
+    """A failure AFTER the batched insert spliced rows device-active (e.g.
+    the tok0 fetch dying) must not leave those rows decoding garbage
+    forever with no host _Slot to retire them: _admit_chunk deactivates the
+    chunk's rows on device and resets their slots before per-chunk
+    isolation swallows the error (ADVICE r4 #1)."""
+
+    def test_post_insert_failure_deactivates_rows(self, setup):
+        cfg, params, _ = setup
+        eng = make_engine(cfg, params)
+
+        class BoomList(list):
+            def __setitem__(self, i, v):
+                raise RuntimeError("boom")
+
+        prompts = [[3, 17, 42], [5, 5, 8]]
+        prepared = []
+        for i, p in enumerate(prompts):
+            key = jax.random.PRNGKey(i)
+            prepared.append((i, i, 16, p, 4, key))
+        with pytest.raises(RuntimeError, match="boom"):
+            eng._admit_chunk(16, prepared, [0, 1], BoomList([None, None]))
+        # rows released on device AND on host
+        assert not np.asarray(eng._active)[:2].any()
+        assert all(not s.active for s in eng.slots)
+        # the engine still serves: a real admission on the same rows works
+        outs = eng.admit_many([(9, [3, 17, 42], 4, None)])
+        assert outs[0][1] is None or isinstance(outs[0][1], list)
+        for _ in range(50):
+            done = eng.step()
+            if done:
+                assert done[0][0] == 9
+                break
+        else:
+            raise AssertionError("request 9 never completed")
