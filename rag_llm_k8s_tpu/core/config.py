@@ -318,7 +318,12 @@ class EngineConfig:
     # overhead), re-probing periodically; "off" is the escape hatch.
     # Env: TPU_RAG_SPECULATIVE.
     speculative: str = "auto"  # "off" | "prompt_lookup" | "auto"
-    spec_ngram: int = 3
+    # match gram size: 2 fires far more often than 3 (any recurring BIGRAM
+    # proposes), and the cost asymmetry favors firing — a fired-but-wrong
+    # verify costs ~0.4 extra decode-steps (the k+1-wide forward's premium)
+    # while a fired-and-right one saves up to k; public prompt-lookup
+    # deployments likewise scan down to 2-grams
+    spec_ngram: int = 2
     spec_tokens: int = 7  # proposals per verify step (k+1 = 8 fed tokens)
     # "auto" keeps speculating only while the acceptance EMA stays above
     # this (tokens emitted per verify forward). Breakeven is the verify

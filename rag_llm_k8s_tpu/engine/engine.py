@@ -389,7 +389,13 @@ class InferenceEngine:
                     # masked out, so wrapped lanes never survive
                     match = match & (jnp.roll(row, j) == tj)
                 idx = jnp.arange(T + k + 1, dtype=i32)
-                match = match & (idx >= kv_start[0] + n - 1) & (idx < wi)
+                # only occurrences whose k-token continuation is already
+                # WRITTEN (idx + k <= wi) may propose: the frontier's own
+                # trailing gram always matches itself but continues into
+                # unwritten pad history — measured on the chain-head 8B
+                # it capped acceptance at ~2 tokens/verify (accept one,
+                # reject at the first pad, every verify)
+                match = match & (idx >= kv_start[0] + n - 1) & (idx + k <= wi)
                 c_star = jnp.max(jnp.where(match, idx, -1))
                 src = jnp.where(c_star >= 0, c_star + 1, 0).astype(i32)
                 props = jax.lax.dynamic_slice(row, (src,), (k,))  # [k]
@@ -457,8 +463,11 @@ class InferenceEngine:
             init = (i32(1), cache, hist0, done0, out0, rng, i32(0))
             _, _, _, _, out, _, iters = jax.lax.while_loop(cond, body, init)
             # iters = verify forwards run; the emitted-token count over it
-            # is the measured acceptance rate (EngineStats.spec_verify_steps)
-            return out[:, :max_new], iters
+            # is the measured acceptance rate (EngineStats.spec_verify_steps).
+            # Packed into the out buffer's first slack slot (never an
+            # emission target): returning it as a second array would cost a
+            # SECOND device->host round trip per generate on a slow link.
+            return out[:, :max_new + 1].at[:, max_new].set(iters)
 
         avals = param_avals(self.params)
         data_sharding = self.mesh.replicated if self.mesh is not None else None
@@ -626,8 +635,9 @@ class InferenceEngine:
         tokens_j, mask_j, rng_j = self._place_inputs(tokens, pad_mask, rng)
         iters = 0
         if spec:
-            out, iters = fn(self.params, tokens_j, mask_j, rng_j)
-            out = np.asarray(out)
+            out = np.asarray(fn(self.params, tokens_j, mask_j, rng_j))  # ONE fetch
+            iters = int(out[0, max_new])  # packed in the slack slot
+            out = out[:, :max_new]
         else:
             out = np.asarray(fn(self.params, tokens_j, mask_j, rng_j))
 

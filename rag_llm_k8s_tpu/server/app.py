@@ -233,14 +233,20 @@ class RagService:
 
                     def fused(params, tokens, mask, emb, norms):
                         vec = model.apply({"params": params}, tokens, mask)
-                        return knn_topk(vec.astype(jnp.float32), emb, norms, k=k_eff)
+                        d, i = knn_topk(vec.astype(jnp.float32), emb, norms, k=k_eff)
+                        # pack (dists, idx) into ONE [B, 2k] array: two
+                        # np.asarray fetches pay two host-link round trips
+                        # (~108 ms EACH over this harness's tunnel — was a
+                        # hidden second RTT on every query). fp32 carries
+                        # row indices exactly up to 2^24 (16M vectors).
+                        return jnp.concatenate([d, i.astype(jnp.float32)], axis=1)
 
                     fn = jax.jit(fused)
                     self._fused_retrieve[key] = fn
-                dists, idx = fn(
+                packed = np.asarray(fn(
                     self.encoder.params, jnp.asarray(tokens), jnp.asarray(mask), emb, norms
-                )
-                dists, idx = np.asarray(dists), np.asarray(idx)
+                ))  # ONE fetch
+                dists, idx = packed[:, :k_eff], packed[:, k_eff:].astype(np.int64)
                 for row, i in enumerate(group):
                     out[i] = (
                         self.store.results_at(idx[row], dists[row]),
