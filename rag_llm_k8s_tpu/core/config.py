@@ -281,13 +281,16 @@ class EngineConfig:
     # request scheduling: "coalesce" = group compatible requests at start
     # (engine/batching.py) — the default: its one device program per batch
     # measured ~1750 tok/s vs the continuous engine's ~300 on the round-4
-    # steady-state bench (BENCH_r04, saturating stream, same 1B model,
-    # concurrency 8), because slot-based serving pays a host sync per
-    # admission GROUP and per decode window. "continuous" = slot-based decode,
-    # requests join the running batch between steps (engine/continuous.py)
-    # — pick it on DIRECTLY-ATTACHED hosts (sync cost ~μs, not the
-    # tunnel's ~130-200 ms) when streaming arrivals make time-to-first-
-    # token matter more than peak throughput; tune decode_sync_steps.
+    # steady-state bench (saturating stream, same 1B model, concurrency 8).
+    # Round 5 isolated the DEVICE-ONLY step rates (tunnel excluded,
+    # BENCH_r05 continuous_device_steps_per_s vs oneshot_steps_per_s): the
+    # slot engine's step is 2.6x slower than the one-shot loop at B=8
+    # (84.7 vs 224.3 steps/s) and ~12x at B=64 (11.8 vs 144.2) — the
+    # per-row dynamic cache splicing does not survive quantification, so
+    # the earlier "directly-attached latency serving" recommendation is
+    # WITHDRAWN: "continuous" remains for mid-stream admission semantics
+    # (requests join a running batch) but is not a performance choice
+    # until its step program is fixed; tune decode_sync_steps if used.
     batching: str = "coalesce"
     # attention backend: "auto" = fused Pallas kernels on TPU, XLA einsum
     # oracle elsewhere (see models.llama.Attention)

@@ -437,3 +437,56 @@ class TestSpServing:
         svc_solo.ready = True
         want = svc_solo.answer("how do the key blocks move?")["generated_text"]
         assert body["generated_text"] == want
+
+
+class TestGreedyDefaultSpeculates:
+    """VERDICT r4 #8: greedy serving (TPU_RAG_DO_SAMPLE=0) gets speculation
+    by DEFAULT (speculative="auto") — and the served /query tokens must be
+    identical to a speculative-off server on the same weights."""
+
+    def _serve(self, llama_cfg, enc_cfg, params, enc_params, speculative):
+        import dataclasses
+
+        cfg = AppConfig(model=llama_cfg, encoder=enc_cfg)
+        # 512: the byte-tokenized RAG prompt is ~470 ids — it must land in
+        # a single-shot bucket (chunked prefill correctly skips spec)
+        ec = EngineConfig(prompt_buckets=(128, 512), max_batch_size=2, max_seq_len=640)
+        if speculative is not None:
+            ec = dataclasses.replace(ec, speculative=speculative)
+        engine = InferenceEngine(
+            llama_cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=8),
+            engine_config=ec, dtypes=FP32,
+        )
+        encoder = EncoderRunner(
+            enc_cfg, enc_params, dtypes=FP32, length_buckets=(32, 64), max_batch=4
+        )
+        store = VectorStore(dim=enc_cfg.hidden_size)
+        service = RagService(cfg, engine, ByteTokenizer(), encoder, ByteTokenizer(), store)
+        service.ready = True
+        return engine, create_app(service).test_client()
+
+    def test_default_engine_speculates_and_matches_off(self):
+        llama_cfg = LlamaConfig.tiny(vocab_size=300)
+        enc_cfg = EncoderConfig.tiny(vocab_size=300)
+        params = init_llama_params(jax.random.PRNGKey(0), llama_cfg, FP32)
+        enc_params = init_encoder_params(jax.random.PRNGKey(1), enc_cfg, FP32)
+        assert EngineConfig().speculative == "auto"  # the default IS on
+
+        pdf = make_pdf("speculation serves greedy queries by default now")
+        answers = {}
+        for mode in (None, "off"):  # None = the default config
+            engine, c = self._serve(llama_cfg, enc_cfg, params, enc_params, mode)
+            r = c.post(
+                "/upload_pdf",
+                data={"file": (io.BytesIO(pdf), "a.pdf")},
+                content_type="multipart/form-data",
+            )
+            assert r.status_code == 200
+            r = c.post("/query", json={"prompt": "what serves greedy queries"})
+            assert r.status_code == 200, r.get_data()
+            answers[mode] = r.get_json()["generated_text"]
+            if mode is None:
+                # the default really took the speculative executable
+                assert engine.stats.spec_verify_steps >= 1
+        assert answers[None] == answers["off"]
