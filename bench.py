@@ -440,6 +440,14 @@ def measure_query_e2e() -> dict:
         cfg_1b, params_1b, "bf16", ingest=False, concurrency=8
     )
     del params_1b, params_1b_q
+    # the ~10 GiB 8B build needs contiguous HBM: drop the 1B executables
+    # (jit caches pin device workspaces) and collect the engines the
+    # schedulers' threads may still reference, or the [32,4096,14336]
+    # int8 leaf allocation OOMs on fragmentation (measured)
+    import gc
+
+    gc.collect()
+    jax.clear_caches()
 
     # ---- flagship: Llama-3.1-8B int8 weights + int8 KV, same WSGI path ----
     # Behavioral synthetic weights (calibrated output peakedness — see
@@ -460,6 +468,8 @@ def measure_query_e2e() -> dict:
         cfg_8b, params_8b, "int8", ingest=False, kv_quant="int8", concurrency=8
     )
     del params_8b
+    gc.collect()
+    jax.clear_caches()  # free the 8B tree + executables for the ingest leg
     # BASELINE config #2 (batch embedding): warm chunks/s through the
     # bucketed encoder, compile and PDF parsing excluded — the reference
     # embeds ONE chunk per SentenceTransformer.encode call (rag.py:55,101).

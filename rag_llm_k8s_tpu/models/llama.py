@@ -475,18 +475,34 @@ class Attention(nn.Module):
             k_w, v_w, k_s, v_s = k, v, None, None
         if self.row_frontier and S == 1:
             # continuous batching: write_index is [B] — each row's token
-            # lands at that row's own frontier (one-slot-per-row scatter,
-            # aliased in place under the scan carry like the slice write)
-            b_idx = jnp.arange(B)
-            k_cache = k_cache.at[layer, b_idx, :, write_index, :].set(
-                k_w[:, 0].astype(k_cache.dtype)
+            # lands at that row's own frontier. NOT a gather-scatter
+            # (.at[layer, b, :, wi_b].set): that lowers to an XLA scatter
+            # which re-materializes the cache and measured 2.6x (B=8) to
+            # 12x (B=64) step time vs the one-shot loop (BENCH_r05
+            # continuous_device_steps_per_s, round-5 isolation). A masked
+            # full-plane write streams the layer's [B, K, T, hd] planes
+            # exactly once (~0.7 ms at B=8 on v5e) and stays aliased under
+            # the scan carry via the scalar-indexed .at[layer].set.
+            T_len = k_cache.shape[3]
+            wi_b = write_index.reshape(B, 1, 1, 1)
+            m = jnp.arange(T_len, dtype=jnp.int32)[None, None, :, None] == wi_b
+            k_cache = k_cache.at[layer].set(
+                jnp.where(m, k_w[:, 0].astype(k_cache.dtype)[:, :, None, :], k_cache[layer])
             )
-            v_cache = v_cache.at[layer, b_idx, :, write_index, :].set(
-                v_w[:, 0].astype(v_cache.dtype)
+            v_cache = v_cache.at[layer].set(
+                jnp.where(m, v_w[:, 0].astype(v_cache.dtype)[:, :, None, :], v_cache[layer])
             )
             if q8:
-                ks_cache = ks_cache.at[layer, b_idx, :, write_index].set(k_s[:, 0])
-                vs_cache = vs_cache.at[layer, b_idx, :, write_index].set(v_s[:, 0])
+                m3 = (
+                    jnp.arange(T_len, dtype=jnp.int32)[None, None, :]
+                    == write_index.reshape(B, 1, 1)
+                )
+                ks_cache = ks_cache.at[layer].set(
+                    jnp.where(m3, k_s[:, 0][:, :, None], ks_cache[layer])
+                )
+                vs_cache = vs_cache.at[layer].set(
+                    jnp.where(m3, v_s[:, 0][:, :, None], vs_cache[layer])
+                )
         else:
             k_cache = jax.lax.dynamic_update_slice(
                 k_cache,
