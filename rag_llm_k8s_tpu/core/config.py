@@ -327,15 +327,23 @@ class EngineConfig:
     # while a fired-and-right one saves up to k; public prompt-lookup
     # deployments likewise scan down to 2-grams
     spec_ngram: int = 2
-    spec_tokens: int = 7  # proposals per verify step (k+1 = 8 fed tokens)
+    # proposals per verify step (k+1 = 16 fed tokens — one MXU lane tile).
+    # Round-5 on-chip sweep at the 8B int8+kv8 behavioral point (bucket
+    # 1024, solo /query p50): k=7 → 1353 ms (2.0 tok/verify), k=15 →
+    # 1261 ms (2.15), k=19 → 1350, k=23 → 1276, k=31 → 1359. Wide spans
+    # win when a match fires (long accepted runs amortize the verify),
+    # and a fired-but-wrong verify still costs only the wide forward's
+    # small premium — k=15 is the measured sweet spot and its width is
+    # lane-aligned.
+    spec_tokens: int = 15
     # "auto" keeps speculating only while the acceptance EMA stays above
     # this (tokens emitted per verify forward). Breakeven is the verify
-    # forward's cost in decode steps — MEASURED 1.39 at the 8B int8+kv8
-    # flagship point (the k+1=8-wide chunked verify vs the 1-wide decode
-    # step, round-5 A/B at acceptance 1.0: 56.6 vs 79.0 tok/s) — so the
-    # default sits just under it: marginal workloads keep probing, clear
-    # losers stop paying the overhead.
-    spec_min_accept: float = 1.35
+    # forward's cost in decode steps — MEASURED 1.39 at width 8 (k=7,
+    # round-5 A/B at acceptance 1.0: 56.6 vs 79.0 tok/s); width 16 adds
+    # a little more (bandwidth-dominated, so width is nearly free) — the
+    # default sits at the width-16 estimate so workloads where lookup
+    # persistently under-delivers stop paying the verify overhead.
+    spec_min_accept: float = 1.5
     # continuous engine: decode steps executed per host sync. 1 = admit and
     # retire between every step (lowest admission latency). >1 runs k steps
     # as ONE device program (lax.scan) and fetches the [k, B] token plane
@@ -356,6 +364,24 @@ class EngineConfig:
     # the kernel; parity bounds in tests. Both engines support it — the
     # continuous engine threads the scale planes through its slot state.)
     kv_quant: str = "bf16"
+    # single-fetch /query serving (survey §7 hard part (e) taken to its
+    # conclusion): solo queries assemble their RAG prompt ON DEVICE from the
+    # fused retrieve's top-k and the store's pre-tokenized chunk segments
+    # (InferenceEngine.generate_rag) — retrieval output never leaves HBM
+    # before generation, and the host pays ONE device→host fetch per query
+    # (the output tokens; the ids fetch for the response's context text
+    # overlaps generation). Prompt assembly is PIECEWISE in token space
+    # (head ‖ chunk segments ‖ tail) with score-free chunk headers — both
+    # properties hold identically on the host fallback path while this is
+    # enabled, so solo and batched answers stay token-consistent; disable
+    # for byte parity with the reference's whole-string prompt format
+    # (rag.py:163-169). Concurrent bursts keep the batched host path.
+    # Env: TPU_RAG_FUSED.
+    rag_fused: bool = True
+    # chunk-token sidecar cap: past this many live vectors the device token
+    # matrix stops being worth its HBM (cap × row_len × 4B) and solo queries
+    # fall back to the host path. 64k rows × 2k tokens ≈ 512 MB.
+    rag_fused_max_vectors: int = 65536
 
 
 @dataclass(frozen=True)
@@ -486,6 +512,11 @@ class AppConfig:
             if k < 1:
                 raise ValueError(f"TPU_RAG_SYNC_STEPS={k}: expected >= 1")
             engine = dataclasses.replace(engine, decode_sync_steps=k)
+        if "TPU_RAG_FUSED" in env:
+            flag = env["TPU_RAG_FUSED"]
+            if flag not in ("0", "1"):
+                raise ValueError(f"TPU_RAG_FUSED={flag!r}: expected '0' or '1'")
+            engine = dataclasses.replace(engine, rag_fused=flag == "1")
         return dataclasses.replace(
             cfg, server=server, mesh=mesh, sampling=sampling, engine=engine
         )

@@ -57,12 +57,30 @@ class Coalescer:
 
     ``max_wait_ms`` can stay tiny (even 0): while the worker is busy with one
     batch, later arrivals queue up and form the next batch naturally.
+
+    ``pending_hint`` (optional, settable after construction): a callable
+    returning how many requests are currently in flight toward this stage.
+    When set, the drain loop stops waiting as soon as every in-flight
+    request has joined the batch — a solo query pays ~ the small
+    ``hint_grace_ms`` instead of the full window, while a burst still
+    coalesces fully. The grace exists because the hint counts only
+    requests that have ENTERED the serving pipeline: a cold burst's
+    stragglers may still be in HTTP parsing when the first request's
+    batch forms, and trusting a hint of 1 instantly would re-create the
+    batch-of-1 burst regression the window prevents. The window deadline
+    stays the upper bound (a hinted request that errors before submitting
+    just costs the old fixed wait).
     """
 
-    def __init__(self, batch_fn, max_batch: int, max_wait_ms: float = 2.0):
+    def __init__(
+        self, batch_fn, max_batch: int, max_wait_ms: float = 2.0, pending_hint=None,
+        hint_grace_ms: float = 4.0,
+    ):
         self.batch_fn = batch_fn
         self.max_batch = max_batch
         self.max_wait_ms = max_wait_ms
+        self.pending_hint = pending_hint
+        self.hint_grace_ms = hint_grace_ms
         self._queue: "queue.Queue[_PendingItem]" = queue.Queue()
         self._stop = threading.Event()
         self._lifecycle_lock = threading.Lock()
@@ -96,9 +114,28 @@ class Coalescer:
                 # absolute deadline: the window bounds the FIRST item's wait;
                 # a per-get timeout would reset on every arrival and stretch
                 # the worst case to (max_batch-1) x window under trickle load
-                deadline = time.monotonic() + self.max_wait_ms / 1e3
+                now = time.monotonic()
+                deadline = now + self.max_wait_ms / 1e3
+                hint_from = now + min(self.hint_grace_ms, self.max_wait_ms) / 1e3
                 while len(batch) < self.max_batch:
-                    remaining = deadline - time.monotonic()
+                    hint = self.pending_hint
+                    now = time.monotonic()
+                    if (
+                        hint is not None and now >= hint_from
+                        and len(batch) >= hint()
+                    ):
+                        # everything in flight toward this stage is already
+                        # aboard — waiting longer can only add latency. The
+                        # grace window has passed, so a cold burst's
+                        # stragglers have had time to register themselves.
+                        break
+                    # with a hint, sleep only until the grace boundary first
+                    # — a timeout there re-evaluates the hint, not the batch
+                    wait_until = (
+                        hint_from if hint is not None and now < hint_from
+                        else deadline
+                    )
+                    remaining = wait_until - now
                     try:
                         # past the deadline, still DRAIN whatever is already
                         # queued (zero wait) — with max_wait_ms=0 this is
@@ -109,6 +146,8 @@ class Coalescer:
                             if remaining > 0 else self._queue.get_nowait()
                         )
                     except queue.Empty:
+                        if wait_until < deadline:
+                            continue  # grace elapsed; re-check the hint
                         break
                     if nxt is None:
                         break
@@ -149,9 +188,11 @@ class BatchScheduler:
         self,
         engine: InferenceEngine,
         max_wait_ms: float = 5.0,
+        pending_hint=None,  # see Coalescer.pending_hint — same contract
     ):
         self.engine = engine
         self.max_wait_ms = max_wait_ms
+        self.pending_hint = pending_hint
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
         # serializes submit's stop-check+enqueue against shutdown's final
@@ -228,6 +269,11 @@ class BatchScheduler:
             # worst case (cap-1) x window under trickle load)
             deadline = time.monotonic() + self.max_wait_ms / 1e3
             while len(batch) < cap:
+                hint = self.pending_hint
+                if hint is not None and len(batch) >= hint():
+                    # every in-flight request is already aboard (solo query:
+                    # immediately) — don't burn the window waiting for nobody
+                    break
                 remaining = deadline - time.monotonic()
                 try:
                     # past the deadline, still drain already-queued items

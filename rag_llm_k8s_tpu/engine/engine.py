@@ -196,6 +196,23 @@ class InferenceEngine:
         ``S`` is a multiple of ``C`` and the prompt prefills through the
         cache in ``C``-sized chunks (long prompts — no silent truncation).
         """
+        gen = self._make_gen(B, S, max_new, chunk)
+        # AOT-compile from abstract shapes (no execution)
+        avals = param_avals(self.params)
+        data_sharding = self.mesh.replicated if self.mesh is not None else None
+        tok_aval = jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=data_sharding)
+        rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=data_sharding)
+        return (
+            jax.jit(gen)
+            .lower(avals, tok_aval, tok_aval, rng_aval)
+            .compile()
+        )
+
+    def _make_gen(self, B: int, S: int, max_new: int, chunk: Optional[int] = None):
+        """The generate graph body ``gen(params, tokens, pad_mask, rng)`` —
+        shared by the direct executable (`_build_generate`) and the
+        device-assembled RAG variant (`_build_generate_rag`), which prepends
+        on-device prompt assembly to the same body."""
         cfg, dt, sampling = self.config, self.dtypes, self.sampling
         model = self.model
         # cache length rounds up to a 128 multiple so the fused decode kernel
@@ -286,16 +303,7 @@ class InferenceEngine:
             _, _, _, _, out, _ = jax.lax.while_loop(cond, body, init)
             return out
 
-        # AOT-compile from abstract shapes (no execution)
-        avals = param_avals(self.params)
-        data_sharding = self.mesh.replicated if self.mesh is not None else None
-        tok_aval = jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=data_sharding)
-        rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=data_sharding)
-        return (
-            jax.jit(gen)
-            .lower(avals, tok_aval, tok_aval, rng_aval)
-            .compile()
-        )
+        return gen
 
     def _build_generate_spec(self, S: int, max_new: int):
         """AOT-compile the SPECULATIVE batch-1 generate executable
@@ -327,6 +335,16 @@ class InferenceEngine:
           (tests/test_speculative.py::TestSampledDistribution), though the
           stream for a pinned seed differs (different rng consumption).
         """
+        gen = self._make_gen_spec(S, max_new)
+        avals = param_avals(self.params)
+        data_sharding = self.mesh.replicated if self.mesh is not None else None
+        tok_aval = jax.ShapeDtypeStruct((1, S), jnp.int32, sharding=data_sharding)
+        rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=data_sharding)
+        return jax.jit(gen).lower(avals, tok_aval, tok_aval, rng_aval).compile()
+
+    def _make_gen_spec(self, S: int, max_new: int):
+        """The speculative batch-1 graph body (see ``_build_generate_spec``)
+        — shared with the device-assembled RAG variant."""
         cfg, dt = self.config, self.dtypes
         model = self.model
         mc = self.model_chunked
@@ -469,11 +487,209 @@ class InferenceEngine:
             # SECOND device->host round trip per generate on a slow link.
             return out[:, :max_new + 1].at[:, max_new].set(iters)
 
+        return gen
+
+    def _build_generate_rag(
+        self, S: int, max_new: int, cap: int, Lc: int, LA: int, LB: int,
+        n: int, kk: int, spec: bool,
+    ):
+        """AOT-compile the SINGLE-FETCH RAG executable: device-side prompt
+        assembly fused in front of the (vanilla or speculative) batch-1
+        generate body.
+
+        The retrieved top-k never leaves HBM before generation: inputs are
+        the fused retrieve's packed ``[1, 2k]`` output (dists ‖ ids, fp32),
+        the store's chunk-token sidecar ``[cap, Lc]``/``[cap]``, the fixed
+        prompt head ``a_ids`` (BOS + system message + "\\n\\nContext: ") and
+        per-query tail ``b_ids`` ("\\n\\nUser: {q}\\n\\nChatbot:", padded to
+        ``LB``). Assembly gathers the top-``n`` chunk rows, keeps the
+        longest prefix of chunks that fits ``S - LA - b_len`` (token-level
+        truncation of the first chunk if even it alone overflows — the
+        device mirror of the host path's budget shrinking), writes the
+        segments left-packed against the right edge, and hands the
+        assembled ``(tokens, pad_mask)`` to the shared generate body. The
+        host sees ONE fetch per query: the output tokens (the retrieve ids
+        fetch for the response's context text overlaps generation).
+        """
+        inner = (
+            self._make_gen_spec(S, max_new) if spec
+            else self._make_gen(1, S, max_new, None)
+        )
+        pad_id = self.pad_id
+        i32 = jnp.int32
+
+        def gen_rag(params, a_ids, b_ids, b_len, packed, store_toks, store_lens, rng):
+            idx = packed[0, kk : kk + n].astype(i32)  # top-n rows, rank order
+            safe = jnp.clip(idx, 0, cap - 1)
+            rows = store_toks[safe]  # [n, Lc] gather
+            lens = store_lens[safe]  # [n]
+            avail = jnp.maximum(S - LA - b_len, 0)
+            keep = jnp.cumsum(lens) <= avail  # monotone: a kept prefix
+            eff = jnp.where(keep, lens, 0)
+            # never drop ALL context: chunk 0 truncates to the budget instead
+            eff = eff.at[0].set(
+                jnp.where(keep[0], lens[0], jnp.minimum(lens[0], avail))
+            )
+            total = (LA + jnp.sum(eff) + b_len).astype(i32)
+            start = S - total
+            # one slack slot at S + Lc - 1 absorbs every masked-out lane:
+            # real writes always land < S (proved by total <= S), so the
+            # junk slot never collides with a real token
+            buf = jnp.full((S + Lc,), pad_id, i32)
+            buf = jax.lax.dynamic_update_slice(buf, a_ids, (start,))
+            off = start + LA + jnp.concatenate(
+                [jnp.zeros((1,), i32), jnp.cumsum(eff)[:-1].astype(i32)]
+            )
+            lane = jnp.arange(Lc, dtype=i32)
+            for i in range(n):  # static unroll over the top-n chunks
+                valid = lane < eff[i]
+                tgt = jnp.where(valid, off[i] + lane, S + Lc - 1)
+                buf = buf.at[tgt].set(jnp.where(valid, rows[i], buf[tgt]))
+            laneb = jnp.arange(LB, dtype=i32)
+            validb = laneb < b_len
+            tgtb = jnp.where(validb, S - b_len + laneb, S + Lc - 1)
+            buf = buf.at[tgtb].set(jnp.where(validb, b_ids, buf[tgtb]))
+            tokens = buf[:S][None, :]
+            pad_mask = (jnp.arange(S) >= start).astype(i32)[None, :]
+            return inner(params, tokens, pad_mask, rng)
+
         avals = param_avals(self.params)
-        data_sharding = self.mesh.replicated if self.mesh is not None else None
-        tok_aval = jax.ShapeDtypeStruct((1, S), jnp.int32, sharding=data_sharding)
-        rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=data_sharding)
-        return jax.jit(gen).lower(avals, tok_aval, tok_aval, rng_aval).compile()
+        ds = self.mesh.replicated if self.mesh is not None else None
+        mk = lambda shape, dtype: jax.ShapeDtypeStruct(shape, dtype, sharding=ds)  # noqa: E731
+        return (
+            jax.jit(gen_rag)
+            .lower(
+                avals,
+                mk((LA,), jnp.int32),
+                mk((LB,), jnp.int32),
+                mk((), jnp.int32),
+                mk((1, 2 * kk), jnp.float32),
+                mk((cap, Lc), jnp.int32),
+                mk((cap,), jnp.int32),
+                mk((2,), jnp.uint32),
+            )
+            .compile()
+        )
+
+    def generate_rag(
+        self,
+        a_ids: np.ndarray,
+        b_ids: np.ndarray,
+        packed,
+        store_toks,
+        store_lens,
+        n_chunks: int,
+        max_new_tokens: Optional[int] = None,
+        seed: Optional[int] = None,
+    ) -> List[int]:
+        """Single-fetch RAG generate (see ``_build_generate_rag``): the
+        caller hands DEVICE arrays for the packed retrieve output and the
+        chunk-token sidecar; only the final output tokens cross to the host.
+        Always serves at the LARGEST prompt bucket (full-context RAG prompts
+        land there; the caller guards that head + tail fit it)."""
+        S = max(self.engine_config.prompt_buckets)
+        max_new = (
+            self.sampling.max_new_tokens if max_new_tokens is None else max_new_tokens
+        )
+        max_new = self._clamp_max_new(S, max_new)
+        a = np.asarray(a_ids, np.int32)
+        b = np.asarray(b_ids, np.int32)
+        LA = int(a.shape[0])
+        # FIXED tail bucket: one executable per store shape instead of a
+        # per-question-length ladder (warmup can then cover every solo
+        # query exactly; 128 scatter lanes are free next to the model).
+        # Tails beyond it are the caller's fallback (host path).
+        LB = self.RAG_TAIL_BUCKET
+        if b.shape[0] > LB:
+            raise ValueError(
+                f"prompt tail of {b.shape[0]} tokens exceeds the fused "
+                f"bucket ({LB}) — route this query through the host path"
+            )
+        b_pad = np.full((LB,), self.pad_id, np.int32)
+        b_pad[: b.shape[0]] = b
+        cap, Lc = int(store_toks.shape[0]), int(store_toks.shape[1])
+        kk = int(packed.shape[1]) // 2
+        n = min(n_chunks, kk)
+        spec = self._spec_applicable(1, None)
+        fn = self._get_rag_compiled(S, max_new, cap, Lc, LA, LB, n, kk, spec)
+        rng = self._next_rng(seed)
+        out = np.asarray(
+            fn(
+                self.params, jnp.asarray(a), jnp.asarray(b_pad),
+                jnp.int32(b.shape[0]), packed, store_toks, store_lens, rng,
+            )
+        )  # the ONE per-query fetch
+        iters = 0
+        if spec:
+            iters = int(out[0, max_new])
+            out = out[:, :max_new]
+        eos = set(self.config.eos_token_ids)
+        row: List[int] = []
+        for t in out[0]:
+            if int(t) in eos:
+                break
+            row.append(int(t))
+        if spec and iters > 0:
+            emitted = len(row) + (1 if len(row) < max_new else 0) - 1
+            self._spec_record(max(emitted, 0), iters)
+        with self._lock:
+            self.stats.generate_calls += 1
+            self.stats.decode_tokens += len(row)
+            # prompt length is decided on device; the head + tail are the
+            # host-known share (the service adds the gathered chunk share
+            # post-hoc once the ids fetch lands — record_prefill)
+            self.stats.prefill_tokens += LA + int(b.shape[0])
+        return row
+
+    def _get_rag_compiled(
+        self, S: int, max_new: int, cap: int, Lc: int, LA: int, LB: int,
+        n: int, kk: int, spec: bool,
+    ):
+        """Get-or-build the single-fetch RAG executable; under
+        ``speculative="auto"`` BOTH the spec and vanilla variants build (the
+        EMA can flip between them mid-serving — a flip must never compile
+        inside a timed request)."""
+        variants = [spec]
+        if self.engine_config.speculative == "auto":
+            variants = [spec, not spec]
+        fn = None
+        for v in variants:
+            key = (1, S, max_new, ("rag", cap, Lc, LA, LB, n, kk, v))
+            with self._lock:
+                built = self._compiled.get(key)
+            if built is None:
+                built = self._build_generate_rag(S, max_new, cap, Lc, LA, LB, n, kk, v)
+                with self._lock:
+                    self._compiled.setdefault(key, built)
+                    built = self._compiled[key]
+            if v == spec:
+                fn = built
+        return fn
+
+    def warm_rag(
+        self, a_len: int, cap: int, Lc: int, kk: int, n: int,
+        max_new_tokens: Optional[int] = None,
+    ) -> None:
+        """AOT-compile the single-fetch RAG executables for the given store
+        shapes (compile only, nothing executes) — called by the service's
+        warmup and its post-ingest hook so production queries never pay the
+        compile. The tail bucket is FIXED (``RAG_TAIL_BUCKET``), so this
+        covers every solo query the fused path will serve."""
+        S = max(self.engine_config.prompt_buckets)
+        max_new = (
+            self.sampling.max_new_tokens if max_new_tokens is None else max_new_tokens
+        )
+        max_new = self._clamp_max_new(S, max_new)
+        spec = self.engine_config.speculative in ("prompt_lookup", "auto")
+        self._get_rag_compiled(
+            S, max_new, cap, Lc, a_len, self.RAG_TAIL_BUCKET, n, kk, spec
+        )
+
+    def record_prefill(self, n_tokens: int) -> None:
+        """Post-hoc prefill-token accounting for device-assembled prompts
+        (the chunk share is only known once the ids fetch lands)."""
+        with self._lock:
+            self.stats.prefill_tokens += int(n_tokens)
 
     def _get_compiled(
         self, B: int, S: int, max_new: int, chunk: Optional[int] = None
@@ -493,6 +709,9 @@ class InferenceEngine:
 
     _SPEC_EMA_DECAY = 0.7
     _SPEC_REPROBE = 32
+    # single-fetch RAG prompt-tail bucket ("\n\nUser: {q}\n\nChatbot:"
+    # padded) — fixed so the executable set is one per store shape
+    RAG_TAIL_BUCKET = 128
 
     def _spec_applicable(self, n_prompts: int, chunk) -> bool:
         """Prompt-lookup speculation serves the batch-1 single-shot case —

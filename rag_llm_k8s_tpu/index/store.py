@@ -170,12 +170,25 @@ def _dev_append(emb, norms, rows, n_old, n_real):
     return emb, norms
 
 
+@jax.jit
+def _tok_append(toks, lens, rows, rlens, n_old):
+    """Splice freshly tokenized chunk rows into the token sidecar at
+    ``n_old`` — the token-plane sibling of ``_dev_append`` (same O(batch)
+    transfer + immutable-pair contract; not donated for the same reason)."""
+    toks = jax.lax.dynamic_update_slice(toks, rows, (n_old, 0))
+    lens = jax.lax.dynamic_update_slice(lens, rlens, (n_old,))
+    return toks, lens
+
+
 @dataclass
 class SearchResult:
-    """One hit: metadata dict + squared-L2 distance (faiss-parity score)."""
+    """One hit: metadata dict + squared-L2 distance (faiss-parity score);
+    ``row`` is the store row id (lets consumers reach the cached token row
+    without re-tokenizing — -1 when externally constructed)."""
 
     metadata: Dict
     distance: float
+    row: int = -1
 
 
 def _content_hash(metadata: Dict) -> str:
@@ -216,6 +229,18 @@ class VectorStore:
         self._dev: Optional[Tuple[jax.Array, jax.Array]] = None
         # observability: ingest-path transfer accounting (tests assert on it)
         self.transfer_stats = {"row_update_batches": 0, "full_uploads": 0}
+        # optional chunk-token sidecar for the single-fetch serving path:
+        # per-row LLM token ids of each chunk's prompt segment, index-aligned
+        # with the vectors, materialized on device via token_snapshot() so a
+        # /query's retrieved rows can be assembled into the prompt ON DEVICE
+        # (the ids never cross to the host before generation). Populated by
+        # the token_source callback at add() time; rows missing it (e.g.
+        # after load()) re-tokenize lazily from metadata in token_snapshot.
+        self._token_fn = None
+        self._chunk_tokens: List[Optional[np.ndarray]] = []
+        self._tok_dev: Optional[Tuple[jax.Array, jax.Array]] = None
+        self._tok_count = 0  # rows reflected in _tok_dev
+        self._tok_build_lock = threading.Lock()  # serializes sidecar builds
 
     # ------------------------------------------------------------------
     # mutation (single-writer)
@@ -249,6 +274,11 @@ class VectorStore:
             self._vectors = np.concatenate([self._vectors, new_rows], axis=0)
             self._metadata.extend(fresh_m)
             self._hashes.update(fresh_h)
+            # token rows fill LAZILY in token_snapshot (tokenizing here would
+            # tax the ingest hot path); the live sidecar pair stays — its
+            # row-coverage counter marks it stale and the next snapshot
+            # call splices just the new rows
+            self._chunk_tokens.extend([None] * len(fresh_m))
             self.generation += 1
             self._append_device_rows(n_old, new_rows)
         return len(fresh_v)
@@ -298,6 +328,142 @@ class VectorStore:
             self.transfer_stats["full_uploads"] += 1
             return self._dev
 
+    def attach_token_source(self, fn) -> None:
+        """Configure the chunk→LLM-token-ids callback (``fn(metadata) ->
+        list[int]``) behind the single-fetch serving path. Idempotent; a
+        CHANGED source drops cached rows (they were produced by the old one)."""
+        with self._lock:
+            if self._token_fn is not None and self._token_fn is not fn:
+                self._chunk_tokens = [None] * len(self._metadata)
+                self._tok_dev = None
+                self._tok_count = 0
+            self._token_fn = fn
+
+    @staticmethod
+    def _build_token_plane(rows, n: int) -> Tuple[jax.Array, jax.Array]:
+        """Pad ``rows[:n]`` into a bucketed ``(tokens [cap, Lc], lens [cap])``
+        device pair — the ONE place the sidecar's bucketing lives."""
+        cap = _pad_bucket(max(n, 1))
+        max_len = max((r.shape[0] for r in rows[:n]), default=1)
+        lc = _pad_bucket(max(max_len, 1), minimum=128)
+        toks = np.zeros((cap, lc), np.int32)
+        lens = np.zeros((cap,), np.int32)
+        for i, row in enumerate(rows[:n]):
+            toks[i, : row.shape[0]] = row
+            lens[i] = row.shape[0]
+        return jnp.asarray(toks), jnp.asarray(lens)
+
+    def token_snapshot(self) -> Tuple[jax.Array, jax.Array]:
+        """Immutable device pair ``(tokens [cap, Lc] int32, lens [cap] int32)``
+        of per-chunk prompt-segment token ids, row-aligned with
+        ``device_snapshot()`` — the gather source for device-side prompt
+        assembly. Requires ``attach_token_source``.
+
+        INCREMENTAL like the vector path: rows added since the last call
+        tokenize (lazily — never inside ``add``) and splice into the live
+        pair with an O(batch) transfer (``_tok_append``); only outgrowing
+        the (cap, Lc) bucket forces a full re-upload, so executable shapes
+        grow O(log N). The service's post-ingest hook calls this so queries
+        at most pay one O(batch) splice, never a corpus rebuild.
+
+        Tokenization and device transfers run OUTSIDE the store lock
+        (seconds at corpus scale — concurrent searches/ingest must not stall
+        behind them). Rows are append-only with stable indices, so a
+        mid-build add just means another loop iteration; a mid-build token-
+        source swap discards the build. ``_tok_build_lock`` serializes
+        builders."""
+        with self._lock:
+            if self._tok_dev is not None and self._tok_count == len(self._metadata):
+                return self._tok_dev
+            if self._token_fn is None:
+                raise RuntimeError("no token source attached (attach_token_source)")
+        with self._tok_build_lock:
+            while True:
+                with self._lock:
+                    n = len(self._metadata)
+                    if self._tok_dev is not None and self._tok_count == n:
+                        return self._tok_dev
+                    fn = self._token_fn
+                    if fn is None:
+                        raise RuntimeError(
+                            "no token source attached (attach_token_source)"
+                        )
+                    rows = list(self._chunk_tokens)
+                    metas = list(self._metadata)
+                    pair, count = self._tok_dev, self._tok_count
+                # -- expensive part, no lock held --
+                fresh = {
+                    i: np.asarray(fn(metas[i]), np.int32)
+                    for i in range(n)
+                    if rows[i] is None
+                }
+                for i, r in fresh.items():
+                    rows[i] = r
+                new_rows = rows[count:n]
+                n_pad = next_pow2(max(len(new_rows), 1))
+                if (
+                    pair is not None
+                    # the PADDED write block must fit: dynamic_update_slice
+                    # CLAMPS an overflowing start index, which would shift
+                    # the block onto earlier real rows (same guard as the
+                    # vector sibling _append_device_rows)
+                    and count + n_pad <= pair[0].shape[0]
+                    and all(r.shape[0] <= pair[0].shape[1] for r in new_rows)
+                ):
+                    # splice: O(batch) transfer into a NEW pair (the old one
+                    # stays immutable for concurrent readers)
+                    lc = int(pair[0].shape[1])
+                    rpad = np.zeros((n_pad, lc), np.int32)
+                    rlen = np.zeros((n_pad,), np.int32)
+                    for j, r in enumerate(new_rows):
+                        rpad[j, : r.shape[0]] = r
+                        rlen[j] = r.shape[0]
+                    built = _tok_append(
+                        pair[0], pair[1], jnp.asarray(rpad), jnp.asarray(rlen),
+                        jnp.int32(count),
+                    )
+                    self.transfer_stats["tok_row_splices"] = (
+                        self.transfer_stats.get("tok_row_splices", 0) + 1
+                    )
+                else:
+                    built = self._build_token_plane(rows, n)
+                    self.transfer_stats["tok_full_uploads"] = (
+                        self.transfer_stats.get("tok_full_uploads", 0) + 1
+                    )
+                with self._lock:
+                    if self._token_fn is not fn:
+                        continue  # source swapped mid-build: discard
+                    # bank the tokenization (append-only, content-stable)
+                    for i, r in fresh.items():
+                        if self._chunk_tokens[i] is None:
+                            self._chunk_tokens[i] = r
+                    self._tok_dev = built
+                    self._tok_count = n
+                    if len(self._metadata) == n:
+                        return built
+                # adds landed mid-build: loop — the committed pair is a
+                # valid n-row snapshot; the next pass splices the rest
+
+    def cached_token_row(self, row: int) -> Optional[np.ndarray]:
+        """The cached token ids for one store row (None when not yet
+        tokenized or out of range) — lets the host prompt path reuse the
+        sidecar's work instead of re-tokenizing the segment per query."""
+        with self._lock:
+            if 0 <= row < len(self._chunk_tokens):
+                return self._chunk_tokens[row]
+            return None
+
+    def token_lengths(self, idxs) -> List[int]:
+        """Cached token-row lengths for the given row ids (0 when a row has
+        not been tokenized yet) — the host mirror of the device budget rule
+        reads these for prefill accounting and context rendering."""
+        with self._lock:
+            out = []
+            for i in idxs:
+                row = self._chunk_tokens[int(i)] if int(i) < len(self._chunk_tokens) else None
+                out.append(0 if row is None else int(row.shape[0]))
+            return out
+
     def search(self, query: np.ndarray, k: int = 5) -> List[SearchResult]:
         """Exact kNN by squared L2 (parity with rag.py:114-120, including the
         distance values the reference surfaces as 'score')."""
@@ -315,7 +481,7 @@ class VectorStore:
         the fused embed+kNN serving path ranks on device and only the final
         k indices ever reach the host."""
         return [
-            SearchResult(metadata=self._metadata[int(i)], distance=float(d))
+            SearchResult(metadata=self._metadata[int(i)], distance=float(d), row=int(i))
             for d, i in zip(dists, idx)
         ]
 
@@ -397,6 +563,9 @@ class VectorStore:
             )
         store._vectors = np.asarray(vectors[:count], np.float32)
         store._metadata = list(meta["metadata"])
+        # token rows are not persisted: they re-derive from metadata text
+        # lazily (token_snapshot) once a token source is attached
+        store._chunk_tokens = [None] * len(store._metadata)
         store._hashes = set(meta.get("hashes", []))
         store.generation = meta.get("generation", 0)
         store.fingerprint = meta.get("fingerprint", "")

@@ -86,6 +86,15 @@ class RagService:
         self.scheduler = scheduler
         self.metrics = _Metrics()
         self.ready = False
+        # per-stage in-flight counters, fed to the coalescers as
+        # ``pending_hint``: each batching stage stops waiting out its window
+        # the moment every request in flight toward it has joined the batch.
+        # A solo query then pays ~0 ms of coalescing window (was a fixed
+        # 25 + 30 ms) while a burst still coalesces fully — the hint only
+        # ever ENDS a wait early; the window deadline remains the bound.
+        self._inflight_lock = threading.Lock()
+        self._inflight_retrieve = 0
+        self._inflight_generate = 0
         # compiled fused embed+kNN executables, keyed (bucket, index_pad, k, B)
         self._fused_retrieve: Dict[tuple, object] = {}
         # concurrent serving: coalesce the embed+kNN stage too — without
@@ -105,12 +114,28 @@ class RagService:
             # 25 ms plus the generate scheduler's 30 ms (server/main.py) —
             # ~55 ms, ~5% of a /query p50 — as the price of burst robustness.
             self.retrieve_coalescer = Coalescer(
-                self._retrieve_many, max_batch=self._retrieve_cap, max_wait_ms=25.0
+                lambda items: self._retrieve_many(items, allow_device=True),
+                max_batch=self._retrieve_cap, max_wait_ms=25.0,
+                pending_hint=lambda: self._inflight_retrieve,
             )
+            if getattr(scheduler, "pending_hint", False) is None:
+                # the generate scheduler is constructed by the caller; give
+                # it the same early-exit hint unless the caller set its own
+                scheduler.pending_hint = lambda: self._inflight_generate
         # ONE EOS policy for ingest and query truncation alike: default the
         # runner's eos from the tokenizer so the two paths cannot diverge
         if encoder is not None and getattr(encoder, "eos_id", None) is None:
             encoder.eos_id = getattr(encoder_tokenizer, "eos_id", None)
+        # single-fetch serving (EngineConfig.rag_fused): the store keeps a
+        # device-resident chunk-token sidecar so solo queries can assemble
+        # their prompt ON DEVICE from the retrieved ids (engine.generate_rag)
+        self._a_ids_cache: Optional[List[int]] = None
+        if (
+            engine is not None
+            and store is not None
+            and getattr(engine.engine_config, "rag_fused", False)
+        ):
+            store.attach_token_source(self._segment_ids)
 
     # -- embedding ------------------------------------------------------
     def embed_texts(self, texts: List[str]) -> np.ndarray:
@@ -147,12 +172,19 @@ class RagService:
             try:
                 cap = self.store.device_snapshot()[0].shape[0]
                 k_eff = min(self.config.retrieval.k, self.store.ntotal)
-                if not any(
+                grew = not any(
                     k[1] == cap and k[2] == k_eff for k in self._fused_retrieve
-                ):
+                )
+                if grew:
                     self._retrieve("warmup")
                     if self.retrieve_coalescer is not None:
                         self._retrieve_many(["warmup"] * self._retrieve_cap)
+                # single-fetch serving: sync the token sidecar EVERY ingest
+                # (an O(batch) splice — token_snapshot; a full rebuild only
+                # when the (cap, Lc) bucket outgrew) and get-or-build the
+                # assembly executables, so neither the sidecar rebuild nor
+                # an Lc-growth compile ever lands inside a user's query
+                self._warm_rag_executables(k_eff)
             except Exception:  # noqa: BLE001 — warmup must not fail ingest
                 logger.exception("post-ingest retrieval warmup failed")
         self.metrics.observe("ingest_seconds", time.monotonic() - t0)
@@ -177,6 +209,70 @@ class RagService:
             logger.warning("No PDF files found in %s", pdf_dir)
         return len(files)
 
+    # -- single-fetch serving (device-side prompt assembly) -------------
+    def _segment_ids(self, metadata: Dict) -> List[int]:
+        """One chunk's prompt segment as LLM token ids — the store's token
+        source AND the host fallback's segment builder, so device-assembled
+        and host-assembled prompts are token-identical by construction.
+        Score-free header (the live retrieval score cannot be pre-tokenized
+        at ingest; the response's context text keeps real scores). Capped at
+        the largest prompt bucket: a longer segment could never fit anyway."""
+        seg = (
+            f"Document '{metadata.get('filename')}' "
+            f"(chunk {metadata.get('chunk_id')}): {metadata.get('text')}\n\n"
+        )
+        ids = self.llm_tokenizer.encode(seg)
+        return ids[: max(self.engine.engine_config.prompt_buckets)]
+
+    def _a_ids(self) -> List[int]:
+        """BOS + "{system}\\n\\nContext: " — the fixed prompt head."""
+        if self._a_ids_cache is None:
+            head = f"{self.config.system_message}\n\nContext: "
+            ids = self.llm_tokenizer.encode(head)
+            bos = self.config.model.bos_token_id
+            if not ids or ids[0] != bos:
+                ids = [bos] + ids
+            self._a_ids_cache = ids
+        return self._a_ids_cache
+
+    def _b_ids(self, user_prompt: str) -> List[int]:
+        """"\\n\\nUser: {q}\\n\\nChatbot:" — the per-query prompt tail."""
+        return self.llm_tokenizer.encode(f"\n\nUser: {user_prompt}\n\nChatbot:")
+
+    def _fused_ok(self) -> bool:
+        """Single-fetch path applicability (cheap, called per retrieve)."""
+        from rag_llm_k8s_tpu.engine.batching import BatchScheduler
+
+        ec = self.engine.engine_config
+        return (
+            getattr(ec, "rag_fused", False)
+            and isinstance(self.scheduler, BatchScheduler)
+            and self.engine.mesh is None
+            and 0 < self.store.ntotal <= ec.rag_fused_max_vectors
+        )
+
+    def _warm_rag_executables(self, k_eff: int) -> None:
+        """Build the chunk-token sidecar and AOT-compile the single-fetch
+        RAG executables for the store's current shapes — from warmup() and
+        the post-ingest growth hook, never a user query."""
+        if not self._fused_ok():
+            return
+        S = max(self.engine.engine_config.prompt_buckets)
+        if len(self._a_ids()) + 1 + 16 > S:
+            # mirror of the SERVE gate in _answer_fused (head + tail + 16
+            # room): skip only when no tail could ever fit — any stricter
+            # and a short-tail query would engage the fused path with no
+            # warmed executable and pay the compile inside the request
+            return
+        toks, _ = self.store.token_snapshot()
+        self.engine.warm_rag(
+            a_len=len(self._a_ids()),
+            cap=int(toks.shape[0]),
+            Lc=int(toks.shape[1]),
+            kk=k_eff,
+            n=min(self.config.retrieval.context_top_n, k_eff),
+        )
+
     # -- fused query embed + kNN ---------------------------------------
     def _retrieve(self, text: str):
         """Embed the query AND rank it against the index in ONE compiled
@@ -186,14 +282,48 @@ class RagService:
         kernel (survey §7 hard part (e)) and halves dispatch overhead."""
         return self._retrieve_many([text])[0]
 
-    def _retrieve_many(self, texts: List[str]):
+    def _fused_retrieve_fn(self, S: int, cap: int, k_eff: int, B_pad: int):
+        """Get-or-build the compiled fused embed+kNN executable for one
+        (bucket, index capacity, k, padded batch) shape."""
+        import jax
+        import jax.numpy as jnp
+
+        from rag_llm_k8s_tpu.ops.knn import knn_topk
+
+        key = (S, cap, k_eff, B_pad)
+        fn = self._fused_retrieve.get(key)
+        if fn is None:
+            model = self.encoder.model
+
+            def fused(params, tokens, mask, emb, norms):
+                vec = model.apply({"params": params}, tokens, mask)
+                d, i = knn_topk(vec.astype(jnp.float32), emb, norms, k=k_eff)
+                # pack (dists, idx) into ONE [B, 2k] array: two
+                # np.asarray fetches pay two host-link round trips
+                # (~108 ms EACH over this harness's tunnel — was a
+                # hidden second RTT on every query). fp32 carries
+                # row indices exactly up to 2^24 (16M vectors).
+                return jnp.concatenate([d, i.astype(jnp.float32)], axis=1)
+
+            fn = jax.jit(fused)
+            self._fused_retrieve[key] = fn
+        return fn
+
+    def _retrieve_many(self, texts: List[str], allow_device: bool = False):
         """Batched fused embed+kNN: N queries → ONE device call per length
         bucket (in practice one — queries are short). Query batches > 1 pad
         to the fixed ``_retrieve_cap`` so concurrency costs exactly ONE extra
         executable, not a ladder; the padded rows ride along free (the
         encoder forward at these lengths is weight-bandwidth-bound, so B=8
         costs barely more than B=1). Returns ``[(results, tokenize_ms)]``
-        in input order."""
+        in input order.
+
+        ``allow_device=True`` (the retrieve coalescer's mode): a SINGLETON
+        batch on the single-fetch path returns the packed device handle
+        unfetched — ``[("__device__", packed_dev, k_eff, tokenize_ms)]`` —
+        so the retrieved ids can feed device-side prompt assembly without a
+        host round trip. Batches > 1 (a burst) keep the host path: they
+        batch through the scheduler, where the per-batch fetch amortizes."""
         import jax
         import jax.numpy as jnp
 
@@ -213,6 +343,15 @@ class RagService:
             tokens, mask = self.encoder.prepare_batch(self.encoder_tokenizer.encode(text))
             prepped.append((tokens, mask, (time.monotonic() - t0) * 1e3))
 
+        if allow_device and len(texts) == 1 and self._fused_ok():
+            tokens, mask, tok_ms = prepped[0]
+            fn = self._fused_retrieve_fn(tokens.shape[1], emb.shape[0], k_eff, 1)
+            packed_dev = fn(
+                self.encoder.params, jnp.asarray(tokens), jnp.asarray(mask),
+                emb, norms,
+            )  # NOT fetched — the ids stay on device for prompt assembly
+            return [("__device__", packed_dev, k_eff, tok_ms)]
+
         out: List = [None] * len(texts)
         by_bucket: Dict[int, List[int]] = {}
         for i, (tokens, _, _) in enumerate(prepped):
@@ -226,23 +365,7 @@ class RagService:
                 for row, i in enumerate(group):
                     tokens[row], mask[row] = prepped[i][0][0], prepped[i][1][0]
 
-                key = (S, emb.shape[0], k_eff, B_pad)
-                fn = self._fused_retrieve.get(key)
-                if fn is None:
-                    model = self.encoder.model
-
-                    def fused(params, tokens, mask, emb, norms):
-                        vec = model.apply({"params": params}, tokens, mask)
-                        d, i = knn_topk(vec.astype(jnp.float32), emb, norms, k=k_eff)
-                        # pack (dists, idx) into ONE [B, 2k] array: two
-                        # np.asarray fetches pay two host-link round trips
-                        # (~108 ms EACH over this harness's tunnel — was a
-                        # hidden second RTT on every query). fp32 carries
-                        # row indices exactly up to 2^24 (16M vectors).
-                        return jnp.concatenate([d, i.astype(jnp.float32)], axis=1)
-
-                    fn = jax.jit(fused)
-                    self._fused_retrieve[key] = fn
+                fn = self._fused_retrieve_fn(S, emb.shape[0], k_eff, B_pad)
                 packed = np.asarray(fn(
                     self.encoder.params, jnp.asarray(tokens), jnp.asarray(mask), emb, norms
                 ))  # ONE fetch
@@ -258,36 +381,104 @@ class RagService:
     def answer(self, user_prompt: str) -> Dict:
         timings: Dict[str, float] = {}
         t_all = time.monotonic()
+        with self._inflight_lock:
+            self._inflight_retrieve += 1
+            self._inflight_generate += 1
+        in_retrieve = in_generate = True
+        try:
+            # embed + kNN run as ONE fused device call, so they cannot be
+            # timed separately; the keys say so explicitly instead of
+            # repurposing the old embed_ms/retrieve_ms split (which would
+            # silently skew any cross-version comparison of stage timings)
+            t0 = time.monotonic()
+            if self.retrieve_coalescer is not None:
+                r = self.retrieve_coalescer.submit(user_prompt)
+            else:
+                r = self._retrieve(user_prompt)
+            with self._inflight_lock:
+                self._inflight_retrieve -= 1
+            in_retrieve = False
 
-        # embed + kNN run as ONE fused device call, so they cannot be timed
-        # separately; the keys say so explicitly instead of repurposing the
-        # old embed_ms/retrieve_ms split (which would silently skew any
-        # cross-version comparison of stage timings)
-        t0 = time.monotonic()
-        if self.retrieve_coalescer is not None:
-            results, tokenize_ms = self.retrieve_coalescer.submit(user_prompt)
-        else:
-            results, tokenize_ms = self._retrieve(user_prompt)
-        timings["tokenize_ms"] = tokenize_ms
-        timings["embed_retrieve_ms"] = (time.monotonic() - t0) * 1e3 - tokenize_ms
+            fused_r = (
+                r if isinstance(r, tuple) and len(r) == 4 and r[0] == "__device__"
+                else None
+            )
+            if fused_r is not None:
+                tokenize_ms = fused_r[3]
+                timings["tokenize_ms"] = tokenize_ms
+                timings["embed_retrieve_ms"] = (
+                    (time.monotonic() - t0) * 1e3 - tokenize_ms
+                )
+                # a fused request never reaches the scheduler: release the
+                # generate claim NOW or the scheduler's pending_hint would
+                # count this phantom for the whole multi-second generate,
+                # forcing concurrent host-path batches to wait out their
+                # full window (re-claimed below if we fall back)
+                with self._inflight_lock:
+                    self._inflight_generate -= 1
+                in_generate = False
+                resp = self._answer_fused(user_prompt, fused_r, timings, t_all)
+                if resp is not None:
+                    return resp
+                with self._inflight_lock:
+                    self._inflight_generate += 1
+                in_generate = True
+                # head + tail didn't fit the bucket (or the sidecar failed):
+                # materialize host results from the device handle and take
+                # the ordinary path below
+                k_eff = fused_r[2]
+                packed = np.asarray(fused_r[1])
+                results = self.store.results_at(
+                    packed[0, k_eff:].astype(np.int64), packed[0, :k_eff]
+                )
+            else:
+                results, tokenize_ms = r
+                timings["tokenize_ms"] = tokenize_ms
+                timings["embed_retrieve_ms"] = (
+                    (time.monotonic() - t0) * 1e3 - tokenize_ms
+                )
 
-        if not results:
-            return {"generated_text": "No relevant information found in the index."}
+            if not results:
+                return {"generated_text": "No relevant information found in the index."}
 
-        context, prompt_ids = self._budgeted_prompt(user_prompt, results)
+            pw = (
+                self._piecewise_prompt(user_prompt, results)
+                if getattr(self.engine.engine_config, "rag_fused", False) else None
+            )
+            if pw is not None:
+                context, prompt_ids = pw
+            else:
+                context, prompt_ids = self._budgeted_prompt(user_prompt, results)
 
-        t0 = time.monotonic()
-        if self.scheduler is not None and len(prompt_ids) <= self._scheduler_prompt_cap():
-            out_ids = self.scheduler.submit(prompt_ids)
-        else:
-            # prompts beyond the scheduler's capability need chunked
-            # prefill, which fixed-length continuous slots cannot do — the
-            # one-shot engine runs them through the cache chunk by chunk
-            # instead of letting the scheduler truncate them
-            out_ids = self.engine.generate([prompt_ids])[0]
-        completion = self.llm_tokenizer.decode(out_ids)
-        timings["generate_ms"] = (time.monotonic() - t0) * 1e3
-        timings["total_ms"] = (time.monotonic() - t_all) * 1e3
+            t0 = time.monotonic()
+            if self.scheduler is not None and len(prompt_ids) <= self._scheduler_prompt_cap():
+                out_ids = self.scheduler.submit(prompt_ids)
+            else:
+                # prompts beyond the scheduler's capability need chunked
+                # prefill, which fixed-length continuous slots cannot do — the
+                # one-shot engine runs them through the cache chunk by chunk
+                # instead of letting the scheduler truncate them. Release
+                # the generate claim first: this request never reaches the
+                # scheduler, so the pending_hint must not wait for it.
+                with self._inflight_lock:
+                    self._inflight_generate -= 1
+                in_generate = False
+                out_ids = self.engine.generate([prompt_ids])[0]
+            if in_generate:
+                with self._inflight_lock:
+                    self._inflight_generate -= 1
+                in_generate = False
+            completion = self.llm_tokenizer.decode(out_ids)
+            timings["generate_ms"] = (time.monotonic() - t0) * 1e3
+            timings["total_ms"] = (time.monotonic() - t_all) * 1e3
+        finally:
+            # error paths (and the no-results return) must release their
+            # claim or the hints would overcount forever after one failure
+            with self._inflight_lock:
+                if in_retrieve:
+                    self._inflight_retrieve -= 1
+                if in_generate:
+                    self._inflight_generate -= 1
 
         self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
         self.metrics.inc("query_decode_tokens", len(out_ids))
@@ -296,6 +487,141 @@ class RagService:
             "context": context,
             "timings": {k: round(v, 2) for k, v in timings.items()},
         }
+
+    def _answer_fused(self, user_prompt: str, fused_r, timings, t_all):
+        """The single-fetch tail of ``answer()``: device-side prompt assembly
+        + generate from the unfetched retrieve handle (engine.generate_rag),
+        with the ids fetch for the response's context text overlapped with
+        generation on a side thread. Returns the response dict, or None when
+        the prompt head + tail can't fit the bucket (caller falls back to
+        the host path, which can chunk-prefill)."""
+        _, packed_dev, k_eff, tokenize_ms = fused_r
+        t_b = time.monotonic()
+        b_ids = self._b_ids(user_prompt)
+        a_ids = self._a_ids()
+        S = max(self.engine.engine_config.prompt_buckets)
+        # 16 tokens of guaranteed context room: below that the assembled
+        # prompt is all head+tail and the host path (which can shrink BOTH
+        # via its word-level trimming, then chunk-prefill) serves better.
+        # Tails past the fixed fused bucket also route host-side.
+        if (
+            len(a_ids) + len(b_ids) + 16 > S
+            or len(b_ids) > self.engine.RAG_TAIL_BUCKET
+        ):
+            return None
+        try:
+            toks_dev, lens_dev = self.store.token_snapshot()
+        except Exception:  # noqa: BLE001 — sidecar failure must not 500 the query
+            logger.exception("chunk-token sidecar unavailable; host fallback")
+            return None
+        timings["tokenize_ms"] = tokenize_ms + (time.monotonic() - t_b) * 1e3
+        n_ctx = min(self.config.retrieval.context_top_n, k_eff)
+
+        box: Dict[str, object] = {}
+
+        def _fetch_ids():
+            try:
+                box["packed"] = np.asarray(packed_dev)
+            except BaseException as e:  # noqa: BLE001 — re-raised on join
+                box["err"] = e
+
+        th = threading.Thread(target=_fetch_ids, daemon=True, name="ids-fetch")
+        th.start()
+        t0 = time.monotonic()
+        out_ids = self.engine.generate_rag(
+            a_ids, b_ids, packed_dev, toks_dev, lens_dev, n_chunks=n_ctx
+        )
+        completion = self.llm_tokenizer.decode(out_ids)
+        timings["generate_ms"] = (time.monotonic() - t0) * 1e3
+        th.join(timeout=120)
+        if "packed" not in box:
+            err = box.get("err")
+            raise err if isinstance(err, BaseException) else RuntimeError(
+                "retrieve ids fetch did not complete"
+            )
+        packed = box["packed"]
+        results = self.store.results_at(
+            packed[0, k_eff:].astype(np.int64), packed[0, :k_eff]
+        )
+        # mirror the device budget rule now that the kept chunk ids are known
+        # host-side: context text renders only the chunks the prompt carried,
+        # and the prefill accounting gets the gathered share
+        n_kept, used, _ = self._kept_chunks(
+            self.store.token_lengths(
+                packed[0, k_eff : k_eff + n_ctx].astype(np.int64)
+            ),
+            S - len(a_ids) - len(b_ids),
+        )
+        context = assemble_context(results, n_kept)
+        self.engine.record_prefill(used)
+        timings["total_ms"] = (time.monotonic() - t_all) * 1e3
+        self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
+        self.metrics.inc("query_decode_tokens", len(out_ids))
+        self.metrics.inc("query_single_fetch", 1)
+        return {
+            "generated_text": extract_answer(completion),
+            "context": context,
+            "timings": {k: round(v, 2) for k, v in timings.items()},
+        }
+
+    def _piecewise_prompt(self, user_prompt: str, results):
+        """Host-side mirror of the device prompt assembly (rag_fused mode):
+        piecewise token concatenation — head ‖ kept chunk segments ‖ tail —
+        under the SAME budget rule (keep the longest chunk prefix that fits;
+        token-truncate the first chunk if it alone overflows), so batched
+        host answers are token-identical to solo device answers. Returns
+        None when head + tail leave no context room (legacy budgeted path
+        handles it, including chunked prefill)."""
+        a_ids = self._a_ids()
+        b_ids = self._b_ids(user_prompt)
+        S = max(self.engine.engine_config.prompt_buckets)
+        avail = S - len(a_ids) - len(b_ids)
+        if avail < 16:
+            return None
+        top_n = self.config.retrieval.context_top_n
+        segs: List[List[int]] = []
+        for r in results[:top_n]:
+            # reuse the sidecar's cached tokenization when the result carries
+            # its store row (avoids re-encoding multi-hundred-token segments
+            # on every batched request)
+            cached = (
+                self.store.cached_token_row(getattr(r, "row", -1))
+                if self.store is not None else None
+            )
+            segs.append(
+                list(cached) if cached is not None else self._segment_ids(r.metadata)
+            )
+        n_kept, _, trunc = self._kept_chunks([len(s) for s in segs], avail)
+        kept = segs[:n_kept]
+        if trunc is not None:
+            kept[0] = kept[0][:trunc]
+        ids = list(a_ids)
+        for seg in kept:
+            ids.extend(seg)
+        ids.extend(b_ids)
+        context = assemble_context(results, n_kept)
+        return context, ids
+
+    @staticmethod
+    def _kept_chunks(seg_lens, avail: int):
+        """THE context-budget rule, in one place — must stay bit-identical
+        to the device assembly in ``engine._build_generate_rag`` (cumsum-
+        prefix keep; token-truncate the first chunk if it alone overflows).
+        Returns ``(n_kept, used_tokens, first_chunk_trunc_len_or_None)``."""
+        used = 0
+        n_kept = 0
+        trunc = None
+        for j, L in enumerate(seg_lens):
+            if used + L <= avail:
+                used += L
+                n_kept += 1
+            else:
+                if j == 0:
+                    trunc = max(avail, 0)
+                    used = trunc
+                    n_kept = 1
+                break
+        return n_kept, used, trunc
 
     def _scheduler_prompt_cap(self) -> int:
         """Longest prompt the serving scheduler can take WITHOUT truncating.
@@ -425,6 +751,10 @@ class RagService:
         if self.retrieve_coalescer is not None and self.store.ntotal:
             # one extra executable: the padded concurrent-retrieval batch
             self._retrieve_many(["warmup"] * self._retrieve_cap)
+        if self.store is not None and self.store.ntotal:
+            # single-fetch serving: sidecar + generate_rag executables warm
+            # here too — the first production solo query must not compile
+            self._warm_rag_executables(min(self.config.retrieval.k, self.store.ntotal))
         self.ready = True
 
     def shutdown(self):

@@ -265,3 +265,73 @@ class TestCoalescer:
             assert sorted(calls[1]) == [1, 2, 3, 4]
         finally:
             co.shutdown()
+
+
+class TestPendingHint:
+    """pending_hint contract: the drain loop exits the moment every request
+    in flight toward the stage is aboard — a solo submit pays ~0 ms of a
+    large window; a hinted burst still coalesces into one batch."""
+
+    def test_coalescer_solo_skips_window(self):
+        from rag_llm_k8s_tpu.engine.batching import Coalescer
+
+        co = Coalescer(
+            lambda items: [x * 10 for x in items], max_batch=8,
+            max_wait_ms=2000.0, pending_hint=lambda: 1,
+        )
+        try:
+            t0 = time.monotonic()
+            assert co.submit(3, timeout=30) == 30
+            # far below the 2 s window: the hint ended the wait immediately
+            assert time.monotonic() - t0 < 0.5
+        finally:
+            co.shutdown()
+
+    def test_coalescer_hinted_burst_still_coalesces(self):
+        from rag_llm_k8s_tpu.engine.batching import Coalescer
+
+        calls = []
+        lock = threading.Lock()
+        inflight = [0]
+
+        def batch_fn(items):
+            with lock:
+                calls.append(list(items))
+            return [x * 10 for x in items]
+
+        co = Coalescer(
+            batch_fn, max_batch=8, max_wait_ms=5000.0,
+            pending_hint=lambda: inflight[0],
+        )
+        try:
+            results = [None] * 4
+            inflight[0] = 4  # all 4 "in flight" before any submit lands
+
+            def run(i):
+                # stagger arrivals well past any fixed-poll granularity
+                time.sleep(0.01 * i)
+                results[i] = co.submit(i, timeout=30)
+
+            threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.monotonic() - t0
+            assert results == [i * 10 for i in range(4)]
+            assert len(calls) == 1 and sorted(calls[0]) == [0, 1, 2, 3]
+            # the batch ran when the 4th arrived, NOT at the 5 s deadline
+            assert wall < 2.0
+        finally:
+            co.shutdown()
+
+    def test_scheduler_solo_skips_window(self, engine):
+        sched = BatchScheduler(engine, max_wait_ms=2000.0, pending_hint=lambda: 1)
+        try:
+            t0 = time.monotonic()
+            out = sched.submit([3, 1, 4], timeout=120)
+            assert time.monotonic() - t0 < 1.0  # not the 2 s window
+            assert out == engine.generate([[3, 1, 4]])[0]
+        finally:
+            sched.shutdown()
