@@ -151,7 +151,8 @@ def _real_tokenizers():
             check=True, timeout=600,
             # the generator logs progress to stdout; the bench's contract is
             # ONE JSON line on stdout — keep the child's chatter off it
-            capture_output=True,
+            # (stderr stays inherited so a failure remains debuggable)
+            stdout=subprocess.PIPE,
         )
     return load_tokenizer(bpe), load_tokenizer(uni)
 

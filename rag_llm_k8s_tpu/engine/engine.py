@@ -182,6 +182,8 @@ class InferenceEngine:
         # chunk_prefill_attention) instead of being silently truncated
         self.model_chunked = self.model.copy(chunked=True)
         self._compiled: Dict[Tuple[int, int, int, Optional[int]], jax.stages.Compiled] = {}
+        # mesh-replicated chunk-token sidecar copies (see _placed_sidecar)
+        self._sidecar_placed: Dict[Tuple[int, int], tuple] = {}
         self._lock = threading.Lock()
         self._rng_counter = 0
         self.stats = EngineStats()
@@ -613,10 +615,23 @@ class InferenceEngine:
         spec = self._spec_applicable(1, None)
         fn = self._get_rag_compiled(S, max_new, cap, Lc, LA, LB, n, kk, spec)
         rng = self._next_rng(seed)
+        a_j, b_j = jnp.asarray(a), jnp.asarray(b_pad)
+        blen_j, rng_j = jnp.int32(b.shape[0]), rng
+        if self.mesh is not None:
+            # the executable was lowered with replicated data shardings:
+            # place the small per-query inputs each call, and the store
+            # sidecar ONCE per snapshot (broadcasting [cap, Lc] per query
+            # would be a full-sidecar transfer at corpus scale — the pair
+            # is immutable, so cache the placed copy keyed by identity)
+            rep = self.mesh.replicated
+            a_j, b_j, blen_j, packed, rng_j = (
+                jax.device_put(x, rep) for x in (a_j, b_j, blen_j, packed, rng)
+            )
+            store_toks, store_lens = self._placed_sidecar(store_toks, store_lens)
         out = np.asarray(
             fn(
-                self.params, jnp.asarray(a), jnp.asarray(b_pad),
-                jnp.int32(b.shape[0]), packed, store_toks, store_lens, rng,
+                self.params, a_j, b_j, blen_j, packed, store_toks, store_lens,
+                rng_j,
             )
         )  # the ONE per-query fetch
         iters = 0
@@ -684,6 +699,33 @@ class InferenceEngine:
         self._get_rag_compiled(
             S, max_new, cap, Lc, a_len, self.RAG_TAIL_BUCKET, n, kk, spec
         )
+
+    def _placed_sidecar(self, store_toks, store_lens):
+        """Mesh-replicated copy of the (immutable) chunk-token sidecar,
+        broadcast once per snapshot identity instead of per query. Holds a
+        reference to the source pair so its id() cannot be recycled. ONE
+        entry only: at the 64k-row cap a generation is ~0.5 GB (source +
+        replicated), so keeping superseded generations would pin real HBM —
+        a snapshot swap pays one re-broadcast and frees the old pair."""
+        key = (id(store_toks), id(store_lens))
+        with self._lock:
+            cached = self._sidecar_placed.get(key)
+        if cached is not None:
+            return cached[1]
+        rep = self.mesh.replicated
+        placed = (
+            jax.device_put(store_toks, rep), jax.device_put(store_lens, rep)
+        )
+        with self._lock:
+            self._sidecar_placed.clear()
+            self._sidecar_placed[key] = ((store_toks, store_lens), placed)
+        return placed
+
+    def drop_placed_sidecar(self) -> None:
+        """Release the mesh-replicated sidecar copy (service shutdown —
+        ``VectorStore.release_token_device`` cannot reach this cache)."""
+        with self._lock:
+            self._sidecar_placed.clear()
 
     def record_prefill(self, n_tokens: int) -> None:
         """Post-hoc prefill-token accounting for device-assembled prompts

@@ -331,13 +331,29 @@ class VectorStore:
     def attach_token_source(self, fn) -> None:
         """Configure the chunk→LLM-token-ids callback (``fn(metadata) ->
         list[int]``) behind the single-fetch serving path. Idempotent; a
-        CHANGED source drops cached rows (they were produced by the old one)."""
+        CHANGED source drops cached rows (they were produced by the old
+        one). Sources carrying an equal ``cache_key`` attribute are treated
+        as the same source (a new service attaching a fresh closure over
+        the same tokenizer keeps the rows)."""
         with self._lock:
-            if self._token_fn is not None and self._token_fn is not fn:
-                self._chunk_tokens = [None] * len(self._metadata)
-                self._tok_dev = None
-                self._tok_count = 0
+            old = self._token_fn
+            if old is not None and old is not fn:
+                okey = getattr(old, "cache_key", None)
+                nkey = getattr(fn, "cache_key", None)
+                if okey is None or nkey is None or okey != nkey:
+                    self._chunk_tokens = [None] * len(self._metadata)
+                    self._tok_dev = None
+                    self._tok_count = 0
             self._token_fn = fn
+
+    def release_token_device(self) -> None:
+        """Drop the device sidecar pair (host rows stay cached) — called by
+        a service's shutdown so a long-lived store does not pin sidecar HBM
+        for a serving stack that no longer exists. The next snapshot call
+        re-uploads from the cached host rows."""
+        with self._lock:
+            self._tok_dev = None
+            self._tok_count = 0
 
     @staticmethod
     def _build_token_plane(rows, n: int) -> Tuple[jax.Array, jax.Array]:
@@ -353,7 +369,7 @@ class VectorStore:
             lens[i] = row.shape[0]
         return jnp.asarray(toks), jnp.asarray(lens)
 
-    def token_snapshot(self) -> Tuple[jax.Array, jax.Array]:
+    def token_snapshot(self, blocking: bool = True):
         """Immutable device pair ``(tokens [cap, Lc] int32, lens [cap] int32)``
         of per-chunk prompt-segment token ids, row-aligned with
         ``device_snapshot()`` — the gather source for device-side prompt
@@ -371,78 +387,96 @@ class VectorStore:
         behind them). Rows are append-only with stable indices, so a
         mid-build add just means another loop iteration; a mid-build token-
         source swap discards the build. ``_tok_build_lock`` serializes
-        builders."""
+        builders.
+
+        ``blocking=False`` (the QUERY path's mode): never wait behind —
+        or perform — a large build inside a request. Returns the fresh
+        pair when available, otherwise None if another thread is mid-build
+        (the caller falls back to the host path); when the build lock is
+        free the splice/build still runs inline, which is O(new rows) —
+        the post-ingest hook keeps that small."""
         with self._lock:
             if self._tok_dev is not None and self._tok_count == len(self._metadata):
                 return self._tok_dev
             if self._token_fn is None:
                 raise RuntimeError("no token source attached (attach_token_source)")
+        if not blocking:
+            if not self._tok_build_lock.acquire(blocking=False):
+                return None
+            try:
+                return self._token_snapshot_locked()
+            finally:
+                self._tok_build_lock.release()
         with self._tok_build_lock:
-            while True:
-                with self._lock:
-                    n = len(self._metadata)
-                    if self._tok_dev is not None and self._tok_count == n:
-                        return self._tok_dev
-                    fn = self._token_fn
-                    if fn is None:
-                        raise RuntimeError(
-                            "no token source attached (attach_token_source)"
-                        )
-                    rows = list(self._chunk_tokens)
-                    metas = list(self._metadata)
-                    pair, count = self._tok_dev, self._tok_count
-                # -- expensive part, no lock held --
-                fresh = {
-                    i: np.asarray(fn(metas[i]), np.int32)
-                    for i in range(n)
-                    if rows[i] is None
-                }
+            return self._token_snapshot_locked()
+
+    def _token_snapshot_locked(self) -> Tuple[jax.Array, jax.Array]:
+        """Body of token_snapshot; caller holds ``_tok_build_lock``."""
+        while True:
+            with self._lock:
+                n = len(self._metadata)
+                if self._tok_dev is not None and self._tok_count == n:
+                    return self._tok_dev
+                fn = self._token_fn
+                if fn is None:
+                    raise RuntimeError(
+                        "no token source attached (attach_token_source)"
+                    )
+                rows = list(self._chunk_tokens)
+                metas = list(self._metadata)
+                pair, count = self._tok_dev, self._tok_count
+            # -- expensive part, no lock held --
+            fresh = {
+                i: np.asarray(fn(metas[i]), np.int32)
+                for i in range(n)
+                if rows[i] is None
+            }
+            for i, r in fresh.items():
+                rows[i] = r
+            new_rows = rows[count:n]
+            n_pad = next_pow2(max(len(new_rows), 1))
+            if (
+                pair is not None
+                # the PADDED write block must fit: dynamic_update_slice
+                # CLAMPS an overflowing start index, which would shift
+                # the block onto earlier real rows (same guard as the
+                # vector sibling _append_device_rows)
+                and count + n_pad <= pair[0].shape[0]
+                and all(r.shape[0] <= pair[0].shape[1] for r in new_rows)
+            ):
+                # splice: O(batch) transfer into a NEW pair (the old one
+                # stays immutable for concurrent readers)
+                lc = int(pair[0].shape[1])
+                rpad = np.zeros((n_pad, lc), np.int32)
+                rlen = np.zeros((n_pad,), np.int32)
+                for j, r in enumerate(new_rows):
+                    rpad[j, : r.shape[0]] = r
+                    rlen[j] = r.shape[0]
+                built = _tok_append(
+                    pair[0], pair[1], jnp.asarray(rpad), jnp.asarray(rlen),
+                    jnp.int32(count),
+                )
+                self.transfer_stats["tok_row_splices"] = (
+                    self.transfer_stats.get("tok_row_splices", 0) + 1
+                )
+            else:
+                built = self._build_token_plane(rows, n)
+                self.transfer_stats["tok_full_uploads"] = (
+                    self.transfer_stats.get("tok_full_uploads", 0) + 1
+                )
+            with self._lock:
+                if self._token_fn is not fn:
+                    continue  # source swapped mid-build: discard
+                # bank the tokenization (append-only, content-stable)
                 for i, r in fresh.items():
-                    rows[i] = r
-                new_rows = rows[count:n]
-                n_pad = next_pow2(max(len(new_rows), 1))
-                if (
-                    pair is not None
-                    # the PADDED write block must fit: dynamic_update_slice
-                    # CLAMPS an overflowing start index, which would shift
-                    # the block onto earlier real rows (same guard as the
-                    # vector sibling _append_device_rows)
-                    and count + n_pad <= pair[0].shape[0]
-                    and all(r.shape[0] <= pair[0].shape[1] for r in new_rows)
-                ):
-                    # splice: O(batch) transfer into a NEW pair (the old one
-                    # stays immutable for concurrent readers)
-                    lc = int(pair[0].shape[1])
-                    rpad = np.zeros((n_pad, lc), np.int32)
-                    rlen = np.zeros((n_pad,), np.int32)
-                    for j, r in enumerate(new_rows):
-                        rpad[j, : r.shape[0]] = r
-                        rlen[j] = r.shape[0]
-                    built = _tok_append(
-                        pair[0], pair[1], jnp.asarray(rpad), jnp.asarray(rlen),
-                        jnp.int32(count),
-                    )
-                    self.transfer_stats["tok_row_splices"] = (
-                        self.transfer_stats.get("tok_row_splices", 0) + 1
-                    )
-                else:
-                    built = self._build_token_plane(rows, n)
-                    self.transfer_stats["tok_full_uploads"] = (
-                        self.transfer_stats.get("tok_full_uploads", 0) + 1
-                    )
-                with self._lock:
-                    if self._token_fn is not fn:
-                        continue  # source swapped mid-build: discard
-                    # bank the tokenization (append-only, content-stable)
-                    for i, r in fresh.items():
-                        if self._chunk_tokens[i] is None:
-                            self._chunk_tokens[i] = r
-                    self._tok_dev = built
-                    self._tok_count = n
-                    if len(self._metadata) == n:
-                        return built
-                # adds landed mid-build: loop — the committed pair is a
-                # valid n-row snapshot; the next pass splices the rest
+                    if self._chunk_tokens[i] is None:
+                        self._chunk_tokens[i] = r
+                self._tok_dev = built
+                self._tok_count = n
+                if len(self._metadata) == n:
+                    return built
+            # adds landed mid-build: loop — the committed pair is a
+            # valid n-row snapshot; the next pass splices the rest
 
     def cached_token_row(self, row: int) -> Optional[np.ndarray]:
         """The cached token ids for one store row (None when not yet

@@ -64,6 +64,28 @@ class _Metrics:
             return dict(self.counters)
 
 
+def make_segment_source(llm_tokenizer, max_bucket: int):
+    """The chunk→prompt-segment token source handed to the store's sidecar.
+
+    A standalone closure ON PURPOSE: the store outlives services (the bench
+    reuses one store across engine configurations; production swaps services
+    on reload), and attaching a BOUND METHOD would make the store retain the
+    whole service → engine → params graph after teardown — measured as a
+    ~2.5 GB HBM leak that OOMed the 8B build. This closure retains only the
+    (host-side) tokenizer. ``cache_key`` lets the store keep its tokenized
+    rows across re-attaches from services sharing the same tokenizer."""
+
+    def segment_ids(metadata: Dict) -> List[int]:
+        seg = (
+            f"Document '{metadata.get('filename')}' "
+            f"(chunk {metadata.get('chunk_id')}): {metadata.get('text')}\n\n"
+        )
+        return llm_tokenizer.encode(seg)[:max_bucket]
+
+    segment_ids.cache_key = ("segment_ids_v1", id(llm_tokenizer), max_bucket)
+    return segment_ids
+
+
 class RagService:
     """The retrieve-then-generate pipeline behind the routes."""
 
@@ -130,12 +152,16 @@ class RagService:
         # device-resident chunk-token sidecar so solo queries can assemble
         # their prompt ON DEVICE from the retrieved ids (engine.generate_rag)
         self._a_ids_cache: Optional[List[int]] = None
+        self._segment_source = None
         if (
             engine is not None
             and store is not None
             and getattr(engine.engine_config, "rag_fused", False)
         ):
-            store.attach_token_source(self._segment_ids)
+            self._segment_source = make_segment_source(
+                llm_tokenizer, max(engine.engine_config.prompt_buckets)
+            )
+            store.attach_token_source(self._segment_source)
 
     # -- embedding ------------------------------------------------------
     def embed_texts(self, texts: List[str]) -> np.ndarray:
@@ -216,13 +242,14 @@ class RagService:
         and host-assembled prompts are token-identical by construction.
         Score-free header (the live retrieval score cannot be pre-tokenized
         at ingest; the response's context text keeps real scores). Capped at
-        the largest prompt bucket: a longer segment could never fit anyway."""
-        seg = (
-            f"Document '{metadata.get('filename')}' "
-            f"(chunk {metadata.get('chunk_id')}): {metadata.get('text')}\n\n"
-        )
-        ids = self.llm_tokenizer.encode(seg)
-        return ids[: max(self.engine.engine_config.prompt_buckets)]
+        the largest prompt bucket: a longer segment could never fit anyway.
+        Delegates to the standalone ``make_segment_source`` closure (the
+        store must never hold a bound method of this service — see there)."""
+        if self._segment_source is None:
+            self._segment_source = make_segment_source(
+                self.llm_tokenizer, max(self.engine.engine_config.prompt_buckets)
+            )
+        return self._segment_source(metadata)
 
     def _a_ids(self) -> List[int]:
         """BOS + "{system}\\n\\nContext: " — the fixed prompt head."""
@@ -247,7 +274,6 @@ class RagService:
         return (
             getattr(ec, "rag_fused", False)
             and isinstance(self.scheduler, BatchScheduler)
-            and self.engine.mesh is None
             and 0 < self.store.ntotal <= ec.rag_fused_max_vectors
         )
 
@@ -510,10 +536,15 @@ class RagService:
         ):
             return None
         try:
-            toks_dev, lens_dev = self.store.token_snapshot()
+            # non-blocking: a sidecar build in progress (a racing ingest's
+            # hook) must not stall this request — fall back to the host path
+            snap = self.store.token_snapshot(blocking=False)
         except Exception:  # noqa: BLE001 — sidecar failure must not 500 the query
             logger.exception("chunk-token sidecar unavailable; host fallback")
             return None
+        if snap is None:
+            return None
+        toks_dev, lens_dev = snap
         timings["tokenize_ms"] = tokenize_ms + (time.monotonic() - t_b) * 1e3
         n_ctx = min(self.config.retrieval.context_top_n, k_eff)
 
@@ -758,11 +789,17 @@ class RagService:
         self.ready = True
 
     def shutdown(self):
-        """Stop the serving threads (coalescers/schedulers). Idempotent."""
+        """Stop the serving threads (coalescers/schedulers) and release the
+        store's device sidecar (the store may outlive this service; its HBM
+        must not). Idempotent."""
         if self.retrieve_coalescer is not None:
             self.retrieve_coalescer.shutdown()
         if self.scheduler is not None:
             self.scheduler.shutdown()
+        if self.store is not None and hasattr(self.store, "release_token_device"):
+            self.store.release_token_device()
+        if self.engine is not None and hasattr(self.engine, "drop_placed_sidecar"):
+            self.engine.drop_placed_sidecar()
 
 
 class WsgiApp:

@@ -305,6 +305,89 @@ class TestFusedService:
             got = np.asarray(toks1[i][: int(lens1[i])]).tolist()
             assert got == want, f"row {i} corrupted"
 
+    def test_single_fetch_serves_over_tp2_mesh(self, devices8):
+        """The production deployment pins TPU_RAG_MESH=tp=8 — the single-
+        fetch path must serve over a mesh (replicated placement for the
+        per-query inputs, a once-per-snapshot broadcast for the sidecar)
+        and answer token-identically to the meshless fused service."""
+        import dataclasses
+
+        from rag_llm_k8s_tpu.core.config import MeshConfig
+        from rag_llm_k8s_tpu.core.mesh import make_mesh
+        from rag_llm_k8s_tpu.parallel.sharding import shard_llama_params
+
+        llama_cfg = dataclasses.replace(
+            LlamaConfig.tiny(vocab_size=300), num_kv_heads=2
+        )
+        enc_cfg = EncoderConfig.tiny(vocab_size=300)
+        cfg = AppConfig(model=llama_cfg, encoder=enc_cfg, system_message="SYS")
+        params = init_llama_params(jax.random.PRNGKey(0), llama_cfg, FP32)
+        from rag_llm_k8s_tpu.models.bge_m3 import init_encoder_params as init_enc
+
+        enc_params = init_enc(jax.random.PRNGKey(1), enc_cfg, FP32)
+        texts = ["alpha beta gamma", "delta epsilon", "zeta eta theta"]
+
+        def serve(mesh_ctx, eng_params):
+            engine = InferenceEngine(
+                llama_cfg, eng_params,
+                sampling=SamplingConfig(do_sample=False, max_new_tokens=4),
+                engine_config=EngineConfig(prompt_buckets=(256,), max_batch_size=2),
+                dtypes=FP32, mesh=mesh_ctx,
+            )
+            encoder = EncoderRunner(
+                enc_cfg, enc_params, dtypes=FP32, length_buckets=(32,), max_batch=4
+            )
+            store = VectorStore(dim=enc_cfg.hidden_size)
+            svc = RagService(
+                cfg, engine, ByteTokenizer(), encoder, ByteTokenizer(), store,
+                scheduler=BatchScheduler(engine, max_wait_ms=20.0),
+            )
+            svc.ready = True
+            vecs = encoder.encode([ByteTokenizer().encode(t) for t in texts])
+            store.add(list(vecs), [
+                {"filename": "f", "chunk_id": i, "text": t}
+                for i, t in enumerate(texts)
+            ])
+            return svc
+
+        ctx = make_mesh(MeshConfig(dp=1, sp=1, tp=2), devices=devices8[:2])
+        svc_mesh = serve(ctx, shard_llama_params(params, ctx))
+        svc_solo = serve(None, params)
+        try:
+            got = svc_mesh.answer("alpha beta")
+            want = svc_solo.answer("alpha beta")
+            assert svc_mesh.metrics.snapshot().get("query_single_fetch") == 1
+            assert svc_solo.metrics.snapshot().get("query_single_fetch") == 1
+            assert got["generated_text"] == want["generated_text"]
+            assert got["context"] == want["context"]
+            # second query reuses the cached replicated sidecar
+            svc_mesh.answer("zeta eta")
+            assert len(svc_mesh.engine._sidecar_placed) == 1
+        finally:
+            svc_mesh.shutdown()
+            svc_solo.shutdown()
+
+    def test_teardown_releases_engine_and_sidecar(self):
+        """A long-lived store must not retain the dead service's engine (a
+        bound-method token source did exactly that — the params graph
+        stayed HBM-resident and OOMed the next model's build) nor keep the
+        device sidecar pair alive past shutdown."""
+        import gc
+        import weakref
+
+        svc = self._service()
+        store = svc.store
+        svc.answer("alpha beta")  # sidecar attached + device pair built
+        assert store._tok_dev is not None
+        svc.shutdown()
+        ref = weakref.ref(svc.engine)
+        del svc
+        gc.collect()
+        assert ref() is None, "engine retained after service teardown"
+        assert store._tok_dev is None  # device pair released
+        # host rows survive for the next service sharing the tokenizer
+        assert any(r is not None for r in store._chunk_tokens)
+
     def test_token_snapshot_survives_save_load(self, tmp_path):
         tok = ByteTokenizer()
         store = make_store(tok, ["one two", "three four"])
