@@ -399,3 +399,60 @@ class Test8BShapesOnChip:
             f"compile {compile_s:.1f}s, decode {tok_s:.0f} tok/s (B={B})"
         )
         assert tok_s > 100  # sanity floor; measured ~610 at B=8
+
+
+class TestSingleFetchOnChip:
+    def test_fused_rag_generate_matches_host_assembly(self):
+        """Hardware counterpart of tests/test_fused_rag.py: device-side
+        prompt assembly (generate_rag) must emit the same greedy tokens as
+        the host-assembled prompt through the SAME engine, on real Mosaic
+        kernels, and cost exactly ONE device->host fetch."""
+        import numpy as np
+
+        from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+        from rag_llm_k8s_tpu.index.store import VectorStore
+        from rag_llm_k8s_tpu.models.llama import init_llama_params
+
+        DT = DTypePolicy()
+        cfg = LlamaConfig.tiny(vocab_size=512)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        eng = InferenceEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=8),
+            engine_config=EngineConfig(prompt_buckets=(256,), max_batch_size=2),
+            dtypes=DT,
+        )
+
+        def seg_ids(md):
+            return [3 + (b % 500) for b in (
+                f"Document '{md['filename']}' (chunk {md['chunk_id']}): "
+                f"{md['text']}\n\n"
+            ).encode()]
+
+        store = VectorStore(dim=8)
+        rng = np.random.default_rng(0)
+        texts = ["alpha beta gamma", "delta epsilon", "zeta eta"]
+        store.add(
+            [rng.standard_normal(8).astype(np.float32) for _ in texts],
+            [{"filename": "f.pdf", "chunk_id": i, "text": t} for i, t in enumerate(texts)],
+        )
+        store.attach_token_source(seg_ids)
+        toks_dev, lens_dev = store.token_snapshot()
+
+        a = [cfg.bos_token_id] + [3 + (b % 500) for b in b"SYS\n\nContext: "]
+        b = [3 + (x % 500) for x in b"\n\nUser: what?\n\nChatbot:"]
+        d = np.linspace(0.1, 0.5, 3, dtype=np.float32)
+        packed = jnp.asarray(
+            np.concatenate([d, np.asarray([2, 0, 1], np.float32)])[None, :]
+        )
+        host_ids = list(a)
+        for i in (2, 0, 1):
+            host_ids += seg_ids(store._metadata[i])
+        host_ids += b
+        assert len(host_ids) <= 256
+        want = eng.generate([host_ids])[0]
+        got = eng.generate_rag(
+            np.asarray(a, np.int32), np.asarray(b, np.int32),
+            packed, toks_dev, lens_dev, n_chunks=3,
+        )
+        assert got == want
