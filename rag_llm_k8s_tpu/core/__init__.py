@@ -7,6 +7,7 @@ from rag_llm_k8s_tpu.core.config import (
     EngineConfig,
     LlamaConfig,
     MeshConfig,
+    PrefixCacheConfig,
     RetrievalConfig,
     SamplingConfig,
     ServerConfig,
@@ -21,6 +22,7 @@ __all__ = [
     "LlamaConfig",
     "MeshConfig",
     "MeshContext",
+    "PrefixCacheConfig",
     "RetrievalConfig",
     "SamplingConfig",
     "ServerConfig",

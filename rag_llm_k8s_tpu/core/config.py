@@ -264,6 +264,56 @@ class SamplingConfig:
 
 
 @dataclass(frozen=True)
+class PrefixCacheConfig:
+    """Cross-request device-resident KV prefix cache (engine/prefix_cache.py).
+
+    Every /generate re-prefills the same fixed prompt head, and popular
+    queries re-prefill the same retrieved chunks. The cache keeps those
+    segments' KV on device, keyed by ``(segment_key, position_slot)`` —
+    RoPE makes K position-dependent, so a cached block is reusable only at
+    the exact token offset it was computed at (the *slot*). A request's
+    matched prefix splices into its fresh cache via ``dynamic_update_slice``
+    and prefill starts at the first non-shared token; misses fall back to
+    normal chunked prefill (and populate the cache as they go).
+    """
+
+    # master switch (env TPU_RAG_PREFIX_CACHE). Off by default: the prefixed
+    # serving path changes the /generate timings block and supersedes the
+    # single-fetch device-assembly path — deployments opt in.
+    enabled: bool = False
+    # HBM budget for the cache's device bytes — segment blocks AND the
+    # assembled full-prefix memo buffers — in MiB (env TPU_RAG_PREFIX_HBM_MB).
+    # A cached token costs L*K*hd*2 bytes per plane and a block stores BOTH
+    # K and V: 128 KiB/token at 8B bf16 (72 KiB int8-KV incl. fp32 scales),
+    # so 512 MiB holds ~4k cached prefix tokens — a head + a few hot chunk
+    # sets (docs/PREFIX_CACHE.md has the table). Assembled buffers evict
+    # first (they only save re-splicing), then least-recently-used blocks;
+    # the pinned head block never does.
+    hbm_budget_mb: int = 512
+    # static capacity (tokens) of the splice buffer every prefixed request
+    # carries — also the largest prefix the cache can represent. Requests
+    # whose head+chunks exceed it fall back to the cold path.
+    max_prefix_tokens: int = 4096
+    # segment blocks pad to these bucket lengths so build/splice executables
+    # stay O(#buckets), not O(#distinct segment lengths)
+    segment_buckets: Tuple[int, ...] = (64, 128, 256, 512, 1024, 1536, 2048)
+    # suffix (the un-cached prompt tail) bucket ladder for the prefixed
+    # generate executables — one executable per (suffix bucket, max_new),
+    # NEVER one per hit pattern (prefix/suffix lengths are dynamic scalars)
+    suffix_buckets: Tuple[int, ...] = (128, 512, 2048)
+    # "exact": a chunk block is reused only when the ENTIRE preceding token
+    # stream matches the one it was computed under — logits-exact (the
+    # parity tests pin this). "slot": offset match alone suffices (HA-RAG-
+    # style hotness reuse — K/V of layers > 0 carry the old left context,
+    # an approximation those systems accept for the prefill savings).
+    reuse: str = "exact"  # "exact" | "slot"
+    # fully-assembled prefix buffers memoized per (segment-chain, length):
+    # a repeated query re-splices nothing — its whole prefix is one device
+    # handle. Small count cap (each buffer is max_prefix_tokens wide).
+    assembled_cache_entries: int = 8
+
+
+@dataclass(frozen=True)
 class EngineConfig:
     """Serving-engine shape limits (no reference equivalent — the reference
     re-runs full HF generate per request, single-threaded)."""
@@ -382,6 +432,8 @@ class EngineConfig:
     # matrix stops being worth its HBM (cap × row_len × 4B) and solo queries
     # fall back to the host path. 64k rows × 2k tokens ≈ 512 MB.
     rag_fused_max_vectors: int = 65536
+    # cross-request KV prefix cache (see PrefixCacheConfig)
+    prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
 
 
 @dataclass(frozen=True)
@@ -517,6 +569,28 @@ class AppConfig:
             if flag not in ("0", "1"):
                 raise ValueError(f"TPU_RAG_FUSED={flag!r}: expected '0' or '1'")
             engine = dataclasses.replace(engine, rag_fused=flag == "1")
+        if "TPU_RAG_PREFIX_CACHE" in env:
+            flag = env["TPU_RAG_PREFIX_CACHE"]
+            if flag not in ("0", "1"):
+                raise ValueError(
+                    f"TPU_RAG_PREFIX_CACHE={flag!r}: expected '0' or '1'"
+                )
+            engine = dataclasses.replace(
+                engine,
+                prefix_cache=dataclasses.replace(
+                    engine.prefix_cache, enabled=flag == "1"
+                ),
+            )
+        if "TPU_RAG_PREFIX_HBM_MB" in env:
+            mb = int(env["TPU_RAG_PREFIX_HBM_MB"])
+            if mb < 1:
+                raise ValueError(f"TPU_RAG_PREFIX_HBM_MB={mb}: expected >= 1")
+            engine = dataclasses.replace(
+                engine,
+                prefix_cache=dataclasses.replace(
+                    engine.prefix_cache, hbm_budget_mb=mb
+                ),
+            )
         return dataclasses.replace(
             cfg, server=server, mesh=mesh, sampling=sampling, engine=engine
         )

@@ -80,15 +80,20 @@ def make_mesh(
     # Force Auto axis types on every path: jax>=0.9's jax.make_mesh defaults to
     # Explicit sharding mode, under which plain indexing of sharded arrays
     # raises ShardingTypeError — this framework uses the Auto (NamedSharding
-    # annotation) model throughout.
-    auto = (jax.sharding.AxisType.Auto,) * 3
-    if devices == list(jax.devices()):
+    # annotation) model throughout. Feature-detected: on jax versions that
+    # predate AxisType (< 0.6), Auto is the ONLY sharding model, so omitting
+    # the argument is semantically identical — without the detection, every
+    # mesh construction (and the whole tp/sp test surface) dies on import
+    # against an older installed jax.
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    type_kw = {} if axis_type is None else {"axis_types": (axis_type.Auto,) * 3}
+    if devices == list(jax.devices()) and hasattr(jax, "make_mesh"):
         mesh = jax.make_mesh(
-            (dp, sp, tp), config.axis_names, devices=devices, axis_types=auto
+            (dp, sp, tp), config.axis_names, devices=devices, **type_kw
         )
     else:
         arr = np.asarray(devices).reshape(dp, sp, tp)
-        mesh = Mesh(arr, config.axis_names, axis_types=auto)
+        mesh = Mesh(arr, config.axis_names, **type_kw)
     return MeshContext(mesh=mesh)
 
 

@@ -123,6 +123,9 @@ class ContinuousEngine:
             fused_qkv=fused, quantized=quantized, kv_quant=self.kv_quant,
         )
         self.model_step = self.model.copy(row_frontier=True)
+        # chunked variant for prefix-cache admissions: the suffix prefills
+        # over a spliced cached-prefix block with offset causality
+        self.model_chunked = self.model.copy(chunked=True)
         self._compiled: Dict[Tuple[str, int, int], jax.stages.Compiled] = {}
         # ---- persistent device state -----------------------------------
         # the cache rides as a TUPLE pytree through every executable:
@@ -217,6 +220,8 @@ class ContinuousEngine:
                 fn = self._build_step(S)  # S carries the sync window here
             elif kind == "prefill":
                 fn = self._build_prefill(S, n)
+            elif kind == "prefill_px":
+                fn = self._build_prefill_prefixed(S, n)  # n carries the suffix bucket
             else:
                 fn = self._build_insert(S, n)
             self._compiled[key] = fn
@@ -303,6 +308,170 @@ class ContinuousEngine:
             jax.ShapeDtypeStruct((n, S), jnp.int32, sharding=rep),
             jax.ShapeDtypeStruct((n, 2), jnp.uint32, sharding=rep),
         ).compile()
+
+    def _build_prefill_prefixed(self, S: int, C: int):
+        """Batch-1 PREFIXED admission (KV prefix cache): splice a
+        ``CachedPrefix`` block into a fresh left-padded ``S``-slot row cache
+        and prefill only the ``C``-bucketed suffix — the row block then goes
+        through the ordinary ``_insert`` executable, which already accepts
+        pre-populated KV rows (it splices whatever row planes it is handed).
+
+        Slot geometry: the row's tokens end at slot ``S`` (left padding), so
+        the prefix block lands at ``start = S - total`` and the suffix
+        chunk-prefills at ``start + prefix_len``. Positions stay canonical
+        (0-based) — RoPE is baked into the cached K by position, not slot.
+        """
+        cfg, dt, sampling = self.config, self.dtypes, self.sampling
+        mc = self.model_chunked
+        kv_quant = self.kv_quant
+        P = self.engine_config.prefix_cache.max_prefix_tokens
+        # the splice buffer is P wide and lands as low as slot 0, and the
+        # suffix write spans [start + prefix_len, start + prefix_len + C):
+        # size the build cache so neither dynamic_update_slice can clamp
+        # (a clamped start silently shifts the block over valid KV)
+        T_build = -(-(S + P + C) // 128) * 128
+        i32 = jnp.int32
+        from rag_llm_k8s_tpu.models.llama import KVCache
+
+        def prefill(params, suffix_tokens, suffix_len, ctx, prefix_len, rngs):
+            cache = make_kv_cache(cfg, 1, T_build, dt.compute_dtype, quant=kv_quant)
+            planes = (
+                (cache.k, cache.v, cache.k_scale, cache.v_scale)
+                if kv_quant == "int8" else (cache.k, cache.v)
+            )
+            plen = prefix_len.astype(i32)
+            slen = suffix_len.astype(i32)
+            total = plen + slen
+            start = (S - total).astype(i32)
+            planes = tuple(
+                jax.lax.dynamic_update_slice(
+                    c, b.astype(c.dtype),
+                    (0, 0, 0, start) + ((0,) if c.ndim == 5 else ()),
+                )
+                for c, b in zip(planes, ctx)
+            )
+            positions = (plen + jnp.arange(C, dtype=i32))[None, :]
+            kv_start = jnp.broadcast_to(start, (1,))
+            # real tokens end exactly at slot S; right-padded suffix K/V
+            # beyond lands at >= S and is dropped by the row slice below
+            logits, cache = mc.apply(
+                {"params": params}, suffix_tokens, positions, KVCache(*planes),
+                kv_start, jnp.full((1,), S, i32), start + plen,
+                logit_index=slen - 1,
+            )
+            tok0 = sample_token_per_row(rngs, logits[:, -1], sampling)
+            out = (
+                (cache.k, cache.v, cache.k_scale, cache.v_scale)
+                if kv_quant == "int8" else (cache.k, cache.v)
+            )
+            rows = tuple(c[:, :, :, :S] for c in out)
+            return rows, tok0, kv_start
+
+        rep = self.mesh.replicated if self.mesh is not None else None
+        ctx_avals = tuple(
+            jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
+            for shape, dtype in self._prefix_plane_shapes(P)
+        )
+        out_shardings = (
+            (self._cache_shardings(), rep, rep) if self.mesh is not None else None
+        )
+        return jax.jit(prefill, out_shardings=out_shardings).lower(
+            param_avals(self.params),
+            jax.ShapeDtypeStruct((1, C), jnp.int32, sharding=rep),
+            jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
+            ctx_avals,
+            jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
+            jax.ShapeDtypeStruct((1, 2), jnp.uint32, sharding=rep),
+        ).compile()
+
+    def _prefix_plane_shapes(self, length: int):
+        """(shape, dtype) per prefix-buffer plane — mirrors the one-shot
+        engine's layout so CachedPrefix descriptors are interchangeable."""
+        c = self.config
+        cdt = jnp.int8 if self.kv_quant == "int8" else self.dtypes.compute_dtype
+        pay = ((c.num_layers, 1, c.num_kv_heads, length, c.head_dim), cdt)
+        out = [pay, pay]
+        if self.kv_quant == "int8":
+            sc = ((c.num_layers, 1, c.num_kv_heads, length), jnp.float32)
+            out += [sc, sc]
+        return out
+
+    def admit_prefixed(
+        self,
+        request_id: int,
+        suffix: Sequence[int],
+        prefix,  # CachedPrefix (engine/prefix_cache.py)
+        max_new: int,
+        seed: Optional[int] = None,
+    ) -> Tuple[int, Optional[List[int]]]:
+        """Admit one request whose prompt head is a cached prefix: only the
+        suffix prefills; the prefix KV splices from the descriptor. Same
+        return contract as ``admit``. Raises ValueError when the shapes
+        don't fit a slot (caller falls back to a plain admission)."""
+        free = self.free_slots()
+        assert free, "admit_prefixed() without a free slot"
+        if not suffix:
+            # logit_index would clip to a PAD position — see generate_prefixed
+            raise ValueError("admit_prefixed needs a non-empty suffix")
+        pc = getattr(self.engine_config, "prefix_cache", None)
+        if pc is None or prefix.capacity != pc.max_prefix_tokens:
+            raise ValueError("prefix descriptor does not match this engine's config")
+        total = prefix.length + len(suffix)
+        S = bucket_len(max(total, 1), self.buckets)
+        if total > S:
+            raise ValueError(
+                f"prefixed prompt of {total} tokens exceeds the largest "
+                f"continuous bucket {S}"
+            )
+        if len(suffix) > max(pc.suffix_buckets):
+            raise ValueError(
+                f"prefixed suffix of {len(suffix)} tokens exceeds the "
+                f"largest suffix bucket {max(pc.suffix_buckets)}"
+            )
+        C = bucket_len(max(len(suffix), 1), pc.suffix_buckets)
+        max_new_c = max(1, min(max_new, self.T - S))
+        if seed is not None:
+            row_key = jax.random.PRNGKey(seed)
+        else:
+            self._rng, row_key = jax.random.split(self._rng)
+        folded = jax.random.fold_in(row_key, total)[None, :]
+
+        toks = np.full((1, C), self.pad_id, np.int32)
+        toks[0, : len(suffix)] = list(suffix)
+        row = free[0]
+        row_cache, tok0s, row_starts = self._get("prefill_px", S, C)(
+            self.params, self._put(toks), self._put(jnp.int32(len(suffix))),
+            tuple(self._put(p) for p in prefix.planes),
+            self._put(jnp.int32(prefix.length)), self._put(folded),
+        )
+        try:
+            (self._cache, self._kv_start, self._kv_len,
+             self._last_tok, self._active, self._rng_keys) = self._get("insert", S, 1)(
+                self._cache, row_cache,
+                self._kv_start, self._kv_len, self._last_tok, self._active,
+                self._rng_keys, self._put(np.asarray([row], np.int32)),
+                row_starts, tok0s, self._put(row_key[None, :]),
+            )
+        except BaseException as e:  # noqa: BLE001 — same contract as _admit_chunk
+            self.reset()
+            raise EngineStateLost("insert failed; engine state reset") from e
+        tok0 = int(np.asarray(tok0s)[0])
+        self.stats.generate_calls += 1
+        self.stats.prefill_tokens += len(suffix)
+        self.stats.prefill_tokens_skipped += int(prefix.length)
+        if tok0 in self.config.eos_token_ids or max_new_c <= 1:
+            out = [] if tok0 in self.config.eos_token_ids else [tok0]
+            self.stats.decode_tokens += len(out)
+            m = np.ones(self.B, bool)
+            m[row] = False
+            self._active = self._active & self._put(jnp.asarray(m))
+            return row, out
+        self.slots[row] = _Slot(
+            request_id=request_id, tokens=[tok0], remaining=max_new_c - 1,
+            active=True,
+        )
+        self.stats.decode_tokens += 1
+        return row, None
 
     def _build_insert(self, S: int, n: int = 1):
         """Splice ``n`` freshly prefilled row blocks + their per-row state

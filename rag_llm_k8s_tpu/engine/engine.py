@@ -44,6 +44,7 @@ from rag_llm_k8s_tpu.core.config import (
 from rag_llm_k8s_tpu.core.mesh import MeshContext
 from rag_llm_k8s_tpu.engine.sampling import NEG_INF, _prepared_logits, sample_token
 from rag_llm_k8s_tpu.models.llama import (
+    KVCache,
     LlamaModel,
     make_kv_cache,
     mask_window,
@@ -51,6 +52,23 @@ from rag_llm_k8s_tpu.models.llama import (
 from rag_llm_k8s_tpu.utils.buckets import bucket_len, next_pow2
 
 logger = logging.getLogger(__name__)
+
+
+@jax.jit
+def _splice_prefix_planes(dst, block, offset):
+    """Write a segment KV block into a prefix buffer at slot ``offset``.
+
+    Both are plane tuples — payloads ``[L, 1, K, T, hd]`` and (int8-KV)
+    scale planes ``[L, 1, K, T]``; the slot axis is 3 in both layouts.
+    jit-cached per (buffer, block-bucket) shape pair, so splicing stays a
+    bounded set of tiny executables regardless of how many distinct prefixes
+    ever assemble.
+    """
+    out = []
+    for c, b in zip(dst, block):
+        starts = (0, 0, 0, offset) + ((0,) if c.ndim == 5 else ())
+        out.append(jax.lax.dynamic_update_slice(c, b.astype(c.dtype), starts))
+    return tuple(out)
 
 
 def _isin(tokens: jax.Array, ids: Tuple[int, ...]) -> jax.Array:
@@ -131,6 +149,10 @@ class EngineStats:
     # e2e bench to report)
     spec_verify_steps: int = 0
     spec_emitted_tokens: int = 0
+    # KV prefix cache: prompt tokens whose prefill was SKIPPED because their
+    # KV was spliced from a cached block (prefill_tokens counts only tokens
+    # actually computed — the two sum to the logical prompt-token total)
+    prefill_tokens_skipped: int = 0
 
 
 class InferenceEngine:
@@ -187,6 +209,16 @@ class InferenceEngine:
         self._lock = threading.Lock()
         self._rng_counter = 0
         self.stats = EngineStats()
+        # cross-request KV prefix cache (engine/prefix_cache.py): owns the
+        # HBM-budgeted LRU of segment blocks; this engine provides the
+        # build/splice/generate executables it drives
+        self.prefix_cache = None
+        self._prefix_zero = None  # lazily built all-zeros splice buffer
+        if getattr(engine_config, "prefix_cache", None) is not None and \
+                engine_config.prefix_cache.enabled:
+            from rag_llm_k8s_tpu.engine.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(engine_config.prefix_cache, self)
 
     # ------------------------------------------------------------------
     # compiled generate graph (one per (B, S, max_new))
@@ -732,6 +764,334 @@ class InferenceEngine:
         (the chunk share is only known once the ids fetch lands)."""
         with self._lock:
             self.stats.prefill_tokens += int(n_tokens)
+
+    # ------------------------------------------------------------------
+    # KV prefix cache (engine/prefix_cache.py drives these)
+    # ------------------------------------------------------------------
+    def _prefix_capacity(self) -> int:
+        return self.engine_config.prefix_cache.max_prefix_tokens
+
+    def _prefix_plane_shapes(self, length: int):
+        """(shape, dtype) per cache plane for a ``length``-slot KV block —
+        payloads first, then (int8-KV) the fp32 scale planes."""
+        c = self.config
+        cdt = (
+            jnp.int8 if self.engine_config.kv_quant == "int8"
+            else self.dtypes.compute_dtype
+        )
+        pay = ((c.num_layers, 1, c.num_kv_heads, length, c.head_dim), cdt)
+        out = [pay, pay]
+        if self.engine_config.kv_quant == "int8":
+            sc = ((c.num_layers, 1, c.num_kv_heads, length), jnp.float32)
+            out += [sc, sc]
+        return out
+
+    def _prefix_plane_avals(self, length: int):
+        ds = self.mesh.replicated if self.mesh is not None else None
+        return tuple(
+            jax.ShapeDtypeStruct(s, d, sharding=ds)
+            for s, d in self._prefix_plane_shapes(length)
+        )
+
+    def prefix_buffer_zero(self):
+        """The shared all-zeros ``[L, 1, K, P, hd]`` splice buffer every
+        prefix assembly starts from (immutable — splices produce new
+        buffers, so one instance serves all threads)."""
+        with self._lock:
+            if self._prefix_zero is None:
+                planes = tuple(
+                    jnp.zeros(s, d)
+                    for s, d in self._prefix_plane_shapes(self._prefix_capacity())
+                )
+                if self.mesh is not None:
+                    planes = tuple(
+                        jax.device_put(p, self.mesh.replicated) for p in planes
+                    )
+                self._prefix_zero = planes
+            return self._prefix_zero
+
+    def splice_prefix(self, buf, block, offset: int):
+        """Splice a segment block into a prefix buffer at slot ``offset``."""
+        return _splice_prefix_planes(buf, block, jnp.int32(offset))
+
+    def build_segment_kv(self, ids: Sequence[int], ctx_planes, ctx_len: int):
+        """Prefill ONE prompt segment with ``ctx_planes[:ctx_len]`` as its
+        left context and return its KV block padded to the segment bucket —
+        the prefix cache's miss-path builder. Counts as real prefill work
+        in the stats (the tokens ARE computed, once)."""
+        pc = self.engine_config.prefix_cache
+        Sb = bucket_len(max(len(ids), 1), pc.segment_buckets)
+        toks = np.full((1, Sb), self.pad_id, np.int32)
+        toks[0, : len(ids)] = ids
+        fn = self._get_segment_kv(Sb)
+        toks_j = jnp.asarray(toks)
+        slen_j, clen_j = jnp.int32(len(ids)), jnp.int32(ctx_len)
+        if self.mesh is not None:
+            rep = self.mesh.replicated
+            toks_j, slen_j, clen_j = (
+                jax.device_put(x, rep) for x in (toks_j, slen_j, clen_j)
+            )
+            ctx_planes = tuple(jax.device_put(p, rep) for p in ctx_planes)
+        block = fn(self.params, toks_j, slen_j, ctx_planes, clen_j)
+        with self._lock:
+            self.stats.prefill_tokens += len(ids)
+        return block
+
+    def _get_segment_kv(self, Sb: int):
+        key = (1, Sb, 0, ("segkv", self._prefix_capacity()))
+        with self._lock:
+            fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build_segment_kv(Sb)
+            with self._lock:
+                self._compiled.setdefault(key, fn)
+                fn = self._compiled[key]
+        return fn
+
+    def _build_segment_kv(self, Sb: int):
+        """AOT-compile the segment-KV builder: chunked prefill of up to
+        ``Sb`` fresh tokens at a dynamic offset over a spliced context
+        prefix, returning the fresh slots' KV block. One executable per
+        segment bucket — never per (segment, offset) pair (both the offset
+        and the real length are dynamic scalars)."""
+        cfg, dt = self.config, self.dtypes
+        mc = self.model_chunked
+        P = self._prefix_capacity()
+        T = -(-(P + Sb) // 128) * 128
+        kvq = self.engine_config.kv_quant
+        i32 = jnp.int32
+
+        def seg(params, tokens, seg_len, ctx, ctx_len):
+            cache = make_kv_cache(cfg, 1, T, dt.compute_dtype, quant=kvq)
+            planes = (
+                (cache.k, cache.v, cache.k_scale, cache.v_scale)
+                if kvq == "int8" else (cache.k, cache.v)
+            )
+            # context splices at slot 0; its garbage tail (>= ctx_len) is
+            # overwritten by this segment's own K/V write below
+            planes = tuple(
+                jax.lax.dynamic_update_slice(c, b.astype(c.dtype), (0,) * c.ndim)
+                for c, b in zip(planes, ctx)
+            )
+            clen = ctx_len.astype(i32)
+            positions = (clen + jnp.arange(Sb, dtype=i32))[None, :]
+            kv_len = jnp.broadcast_to(clen + seg_len, (1,)).astype(i32)
+            _, cache = mc.apply(
+                {"params": params}, tokens, positions, KVCache(*planes),
+                jnp.zeros((1,), i32), kv_len, clen, last_logit_only=True,
+            )
+            out = (
+                (cache.k, cache.v, cache.k_scale, cache.v_scale)
+                if kvq == "int8" else (cache.k, cache.v)
+            )
+            return tuple(
+                jax.lax.dynamic_slice(
+                    c,
+                    (0, 0, 0, clen) + ((0,) if c.ndim == 5 else ()),
+                    c.shape[:3] + (Sb,) + c.shape[4:],
+                )
+                for c in out
+            )
+
+        ds = self.mesh.replicated if self.mesh is not None else None
+        out_shardings = (
+            tuple(ds for _ in self._prefix_plane_shapes(Sb))
+            if self.mesh is not None else None
+        )
+        return (
+            jax.jit(seg, out_shardings=out_shardings)
+            .lower(
+                param_avals(self.params),
+                jax.ShapeDtypeStruct((1, Sb), jnp.int32, sharding=ds),
+                jax.ShapeDtypeStruct((), jnp.int32, sharding=ds),
+                self._prefix_plane_avals(P),
+                jax.ShapeDtypeStruct((), jnp.int32, sharding=ds),
+            )
+            .compile()
+        )
+
+    def _make_gen_prefixed(self, S_suf: int, max_new: int):
+        """The prefixed generate body: splice a CachedPrefix buffer into a
+        fresh cache, chunk-prefill only the (right-padded) suffix at the
+        dynamic prefix frontier, then run the standard decode loop. Prefix
+        and suffix lengths are DYNAMIC scalars — every hit pattern reuses
+        the one ``(P, S_suf, max_new)`` executable."""
+        cfg, dt, sampling = self.config, self.dtypes, self.sampling
+        model = self.model
+        mc = self.model_chunked
+        P = self._prefix_capacity()
+        T = -(-(P + S_suf + max_new) // 128) * 128
+        eos_ids = cfg.eos_token_ids
+        kvq = self.engine_config.kv_quant
+        pad_id = self.pad_id
+        i32 = jnp.int32
+
+        def gen(params, prefix_kv, prefix_len, tokens, suffix_len, rng):
+            cache = make_kv_cache(cfg, 1, T, dt.compute_dtype, quant=kvq)
+            planes = (
+                (cache.k, cache.v, cache.k_scale, cache.v_scale)
+                if kvq == "int8" else (cache.k, cache.v)
+            )
+            planes = tuple(
+                jax.lax.dynamic_update_slice(c, b.astype(c.dtype), (0,) * c.ndim)
+                for c, b in zip(planes, prefix_kv)
+            )
+            cache = KVCache(*planes)
+            plen = prefix_len.astype(i32)
+            slen = suffix_len.astype(i32)
+            total = plen + slen
+            kv_start = jnp.zeros((1,), i32)  # left-ALIGNED batch-1 layout
+            # suffix is right-padded: pad K/V land in [total, plen + S_suf),
+            # outside every kv window until decode overwrites them in order
+            positions = (plen + jnp.arange(S_suf, dtype=i32))[None, :]
+            logits, cache = mc.apply(
+                {"params": params}, tokens, positions, cache,
+                kv_start, jnp.broadcast_to(total, (1,)), plen,
+                logit_index=slen - 1,
+            )
+            rng, k0 = jax.random.split(rng)
+            tok0 = sample_token(k0, logits[:, -1], sampling)
+            done0 = _isin(tok0, eos_ids)
+            out0 = jnp.full((1, max_new), pad_id, i32).at[:, 0].set(tok0)
+
+            def cond(c):
+                step, _, _, done, _, _ = c
+                return (step < max_new) & ~jnp.all(done)
+
+            def body(c):
+                step, cache, last_tok, done, out, rng = c
+                # left-aligned: cache slot == sequence position
+                write_index = (total + step - 1).astype(i32)
+                pos = jnp.broadcast_to(write_index, (1,))[:, None]
+                kv_len = jnp.broadcast_to(write_index + 1, (1,))
+                logits, cache = model.apply(
+                    {"params": params}, last_tok[:, None], pos, cache,
+                    kv_start, kv_len, write_index,
+                )
+                rng, k = jax.random.split(rng)
+                tok = sample_token(k, logits[:, 0], sampling)
+                tok = jnp.where(done, jnp.int32(eos_ids[0]), tok)
+                done = done | _isin(tok, eos_ids)
+                out = out.at[:, step].set(tok)
+                return (step + 1, cache, tok, done, out, rng)
+
+            init = (jnp.int32(1), cache, tok0, done0, out0, rng)
+            _, _, _, _, out, _ = jax.lax.while_loop(cond, body, init)
+            return out
+
+        return gen
+
+    def _build_generate_prefixed(self, S_suf: int, max_new: int):
+        ds = self.mesh.replicated if self.mesh is not None else None
+        return (
+            jax.jit(self._make_gen_prefixed(S_suf, max_new))
+            .lower(
+                param_avals(self.params),
+                self._prefix_plane_avals(self._prefix_capacity()),
+                jax.ShapeDtypeStruct((), jnp.int32, sharding=ds),
+                jax.ShapeDtypeStruct((1, S_suf), jnp.int32, sharding=ds),
+                jax.ShapeDtypeStruct((), jnp.int32, sharding=ds),
+                jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=ds),
+            )
+            .compile()
+        )
+
+    def generate_prefixed(
+        self,
+        suffix_ids: Sequence[int],
+        prefix,  # CachedPrefix
+        max_new_tokens: Optional[int] = None,
+        seed: Optional[int] = None,
+    ) -> List[int]:
+        """Generate with a device-resident cached prefix: prefill touches
+        only ``suffix_ids`` (the un-cached prompt tail); the prefix KV is
+        spliced from ``prefix.planes``. Raises ValueError when the suffix
+        exceeds the bucket ladder (caller falls back to the cold path)."""
+        pc = self.engine_config.prefix_cache
+        if not suffix_ids:
+            # an empty suffix would sample tok0 from a PAD token's logits
+            # (logit_index clips to 0) — a silently wrong first token; every
+            # real prompt has at least the per-query tail
+            raise ValueError("generate_prefixed needs a non-empty suffix")
+        n_suf = len(suffix_ids)
+        if n_suf > max(pc.suffix_buckets):
+            raise ValueError(
+                f"prefixed suffix of {n_suf} tokens exceeds the largest "
+                f"suffix bucket ({max(pc.suffix_buckets)})"
+            )
+        S_suf = bucket_len(n_suf, pc.suffix_buckets)
+        max_new = (
+            self.sampling.max_new_tokens if max_new_tokens is None else max_new_tokens
+        )
+        max_new = max(
+            1, min(max_new, self.engine_config.max_seq_len
+                   - max(self.engine_config.prompt_buckets))
+        )
+        key = (1, S_suf, max_new, ("prefix", self._prefix_capacity()))
+        with self._lock:
+            fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build_generate_prefixed(S_suf, max_new)
+            with self._lock:
+                self._compiled.setdefault(key, fn)
+                fn = self._compiled[key]
+        toks = np.full((1, S_suf), self.pad_id, np.int32)
+        toks[0, : len(suffix_ids)] = list(suffix_ids)
+        rng = self._next_rng(seed)
+        toks_j = jnp.asarray(toks)
+        plen_j = jnp.int32(prefix.length)
+        slen_j = jnp.int32(len(suffix_ids))
+        planes = prefix.planes
+        if self.mesh is not None:
+            rep = self.mesh.replicated
+            toks_j, plen_j, slen_j, rng = (
+                jax.device_put(x, rep) for x in (toks_j, plen_j, slen_j, rng)
+            )
+            planes = tuple(jax.device_put(p, rep) for p in planes)
+        out = np.asarray(fn(self.params, planes, plen_j, toks_j, slen_j, rng))
+        eos = set(self.config.eos_token_ids)
+        row: List[int] = []
+        for t in out[0]:
+            if int(t) in eos:
+                break
+            row.append(int(t))
+        with self._lock:
+            self.stats.generate_calls += 1
+            self.stats.prefill_tokens += len(suffix_ids)
+            self.stats.prefill_tokens_skipped += int(prefix.reused_tokens)
+            self.stats.decode_tokens += len(row)
+        return row
+
+    def warm_prefixed(
+        self,
+        suffix_lens: Sequence[int] = (),
+        max_new_tokens: Optional[int] = None,
+    ) -> None:
+        """AOT-compile the prefixed generate executables for the suffix
+        buckets serving will hit (compile only — the service's warmup and
+        post-ingest hook call this so a cache hit never pays a compile)."""
+        if self.prefix_cache is None:
+            return
+        pc = self.engine_config.prefix_cache
+        max_new = (
+            self.sampling.max_new_tokens if max_new_tokens is None else max_new_tokens
+        )
+        max_new = max(
+            1, min(max_new, self.engine_config.max_seq_len
+                   - max(self.engine_config.prompt_buckets))
+        )
+        buckets = {
+            bucket_len(min(max(n, 1), max(pc.suffix_buckets)), pc.suffix_buckets)
+            for n in (suffix_lens or (self.RAG_TAIL_BUCKET,))
+        }
+        for S_suf in sorted(buckets):
+            key = (1, S_suf, max_new, ("prefix", self._prefix_capacity()))
+            with self._lock:
+                built = key in self._compiled
+            if not built:
+                fn = self._build_generate_prefixed(S_suf, max_new)
+                with self._lock:
+                    self._compiled.setdefault(key, fn)
 
     def _get_compiled(
         self, B: int, S: int, max_new: int, chunk: Optional[int] = None

@@ -1,0 +1,272 @@
+"""Cross-request device-resident KV prefix cache.
+
+Every /generate request re-prefills the same fixed prompt head (BOS + system
+message + "\\n\\nContext: "), and popular queries re-prefill the same
+retrieved chunks — even though the engine already supports chunked prefill
+with offset causality over a populated cache prefix. This module keeps those
+shared segments' KV **on device** and splices them into each request's fresh
+cache via ``dynamic_update_slice``, so prefill starts at the first non-shared
+token (HA-RAG / SIFT: KV reuse for shared RAG prompt segments is the dominant
+prefill optimization for retrieve-then-generate serving).
+
+Anatomy:
+
+- **Segment blocks** (``_Entry``): per-segment KV ``[L, 1, K, Sb, hd]``
+  (+ fp32 scale planes under int8-KV), padded to a bucketed length ``Sb``,
+  held in an HBM-budgeted LRU keyed by ``(segment_key, position_slot)``.
+  RoPE makes K position-dependent, so a block is reusable only at the exact
+  token offset (*slot*) it was computed at; under the default ``reuse=
+  "exact"`` policy the key additionally carries the chain of segment keys
+  that preceded it — K/V of layers > 0 attend over the left context, so an
+  exact-chain match is what makes cached-vs-cold logits IDENTICAL (the
+  parity contract tests/test_prefix_cache.py pins). ``reuse="slot"`` relaxes
+  to offset-only matching (HA-RAG-style hotness reuse: an approximation
+  those systems accept for the prefill savings).
+- **Assembled buffers**: the fully spliced ``[L, 1, K, P, hd]`` prefix a
+  request hands to ``InferenceEngine.generate_prefixed``, memoized per
+  segment chain so a repeated query re-splices nothing — its whole prefix
+  is one device handle and prefill touches only the per-query tail.
+- **Miss path**: the first request for a segment builds its block with the
+  engine's AOT segment-prefill executable (the same chunked-prefill model
+  the long-prompt path uses) — prefill work equivalent to the cold path,
+  plus the slice/splice — and every later slot-matched request skips it.
+
+The cache never changes executable shapes: prefix/suffix lengths are dynamic
+scalars inside a fixed ``(P, suffix_bucket, max_new)`` executable, so a new
+hit pattern never triggers an AOT compile.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class CachedPrefix:
+    """A resolved, device-resident prompt prefix ready to splice.
+
+    ``planes`` is the KV tuple ``(k, v)`` — or ``(k, v, k_scale, v_scale)``
+    under int8-KV — each ``[L, 1, K, P, hd]`` (scales ``[L, 1, K, P]``),
+    with real content in slots ``[0, length)`` and don't-care beyond (the
+    consumer's kv windows never reach it). Consumed by
+    ``InferenceEngine.generate_prefixed`` and
+    ``ContinuousEngine.admit_prefixed``.
+    """
+
+    planes: Tuple
+    length: int  # real prefix tokens covered
+    capacity: int  # P — the static splice-buffer width
+    reused_tokens: int  # tokens whose KV came from cache hits
+    computed_tokens: int  # tokens prefilled (cache misses) to build this
+
+
+@dataclass
+class _Entry:
+    planes: Tuple  # [L, 1, K, Sb, hd] (+ scale planes) device arrays
+    seg_len: int  # real tokens (<= bucket)
+    nbytes: int
+    pinned: bool = False
+
+
+def _planes_nbytes(planes: Tuple) -> int:
+    return int(sum(int(p.nbytes) for p in planes))
+
+
+class PrefixCache:
+    """HBM-budgeted LRU of segment KV blocks + assembled prefix buffers.
+
+    Thread-safe; device work (build/splice) runs outside the lock — entries
+    and buffers are immutable device arrays, so concurrent readers never see
+    a partially written block.
+    """
+
+    def __init__(self, config, engine):
+        if config.reuse not in ("exact", "slot"):
+            raise ValueError(
+                f"prefix_cache.reuse={config.reuse!r}: expected 'exact' or 'slot'"
+            )
+        self.config = config
+        self.engine = engine  # owning InferenceEngine (builds the blocks)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._assembled: "OrderedDict[tuple, Tuple[Tuple, int]]" = OrderedDict()
+        self._pinned_keys: set = set()
+        self.entry_bytes = 0
+        self.assembled_bytes = 0
+        # counters (read by /metrics and bench.py)
+        self.hits = 0
+        self.misses = 0
+        self.tokens_reused = 0
+        self.tokens_computed = 0
+
+    # -- keys -----------------------------------------------------------
+    def _entry_key(self, seg_key: str, offset: int, chain: Tuple[str, ...]):
+        if self.config.reuse == "slot":
+            return (seg_key, offset)
+        return (seg_key, offset, chain)
+
+    def pin(self, seg_key: str) -> None:
+        """Mark a segment key (e.g. the fixed prompt head) never-evicted."""
+        with self._lock:
+            self._pinned_keys.add(seg_key)
+            for k, e in self._entries.items():
+                if k[0] == seg_key:
+                    e.pinned = True
+
+    # -- stats ----------------------------------------------------------
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "prefix_cache_hits": self.hits,
+                "prefix_cache_misses": self.misses,
+                "prefill_tokens_skipped": self.tokens_reused,
+                "prefix_cache_entries": len(self._entries),
+                # TOTAL device bytes held: segment blocks + the assembled
+                # full-prefix memo buffers (both count against the budget)
+                "prefix_cache_bytes": self.entry_bytes + self.assembled_bytes,
+            }
+
+    # -- the one public resolve/populate entry point ---------------------
+    def prefix_for(self, segments: Sequence[Tuple[str, Sequence[int]]]
+                   ) -> Optional[CachedPrefix]:
+        """Resolve an ordered segment list ``[(key, token_ids), ...]`` into a
+        spliced prefix buffer, building (and caching) any missing blocks —
+        the miss path IS the populate path, so prefill work is never done
+        twice for a slot-matched segment. Returns None when the prefix can't
+        be represented (over the buffer capacity, or a single segment over
+        the largest segment bucket) — the caller falls back to cold prefill.
+        """
+        total = sum(len(ids) for _, ids in segments)
+        P = self.config.max_prefix_tokens
+        if total == 0 or total > P:
+            return None
+        max_seg = max(self.config.segment_buckets)
+        if any(len(ids) > max_seg for _, ids in segments):
+            return None
+
+        chain_full = tuple(k for k, _ in segments)
+        akey = (chain_full, total)
+        with self._lock:
+            memo = self._assembled.get(akey)
+            if memo is not None:
+                self._assembled.move_to_end(akey)
+                # touch member entries so the LRU order tracks real use
+                off, chain = 0, ()
+                for key, ids in segments:
+                    ek = self._entry_key(key, off, chain)
+                    if ek in self._entries:
+                        self._entries.move_to_end(ek)
+                    off += len(ids)
+                    chain = chain + (key,)
+                self.hits += len(segments)
+                self.tokens_reused += total
+                return CachedPrefix(memo[0], memo[1], P, total, 0)
+
+        buf = self.engine.prefix_buffer_zero()
+        off = 0
+        chain: Tuple[str, ...] = ()
+        reused = computed = n_hit = n_miss = 0
+        for key, ids in segments:
+            seg_len = len(ids)
+            ek = self._entry_key(key, off, chain)
+            with self._lock:
+                e = self._entries.get(ek)
+                if e is not None and e.seg_len == seg_len:
+                    self._entries.move_to_end(ek)
+                else:
+                    e = None  # slot/length mismatch: treat as a miss
+            if e is None:
+                # build with the true left context (buf holds chain's KV):
+                # under "exact" reuse this makes the block bit-faithful to
+                # what a cold prefill would have computed at these slots
+                planes = self.engine.build_segment_kv(list(ids), buf, off)
+                e = _Entry(
+                    planes=planes, seg_len=seg_len,
+                    nbytes=_planes_nbytes(planes),
+                    pinned=key in self._pinned_keys,
+                )
+                self._insert(ek, e)
+                n_miss += 1
+                computed += seg_len
+            else:
+                n_hit += 1
+                reused += seg_len
+            buf = self.engine.splice_prefix(buf, e.planes, off)
+            off += seg_len
+            chain = chain + (key,)
+
+        buf_bytes = _planes_nbytes(buf)
+        with self._lock:
+            self.hits += n_hit
+            self.misses += n_miss
+            self.tokens_reused += reused
+            self.tokens_computed += computed
+            # two threads can resolve the same chain concurrently (both miss
+            # the memo check): drop the loser's bytes before re-assigning or
+            # assembled_bytes would over-count forever
+            prev = self._assembled.pop(akey, None)
+            if prev is not None:
+                self.assembled_bytes -= _planes_nbytes(prev[0])
+            self._assembled[akey] = (buf, off)
+            self.assembled_bytes += buf_bytes
+            # assembled buffers are full-capacity (P-wide) planes — at 8B
+            # defaults ~512 MiB EACH — so they share the ONE HBM budget with
+            # the segment blocks and, being pure re-splice avoidance, evict
+            # FIRST (oldest chain first; the buffer just added is kept so a
+            # repeat of this very query still skips its splices)
+            budget = int(self.config.hbm_budget_mb) * (1 << 20)
+            cap = max(1, int(self.config.assembled_cache_entries))
+            for k in list(self._assembled):
+                if (
+                    len(self._assembled) <= cap
+                    and self.entry_bytes + self.assembled_bytes <= budget
+                ):
+                    break
+                if k == akey:
+                    continue
+                old_buf, _ = self._assembled.pop(k)
+                self.assembled_bytes -= _planes_nbytes(old_buf)
+        return CachedPrefix(buf, off, P, reused, computed)
+
+    # -- LRU bookkeeping -------------------------------------------------
+    def _insert(self, key, entry: _Entry) -> None:
+        budget = int(self.config.hbm_budget_mb) * (1 << 20)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.entry_bytes -= old.nbytes
+            self._entries[key] = entry
+            self.entry_bytes += entry.nbytes
+            # assembled buffers (pure re-splice avoidance) evict before any
+            # segment block does — a block eviction costs a real re-prefill
+            while (
+                self._assembled
+                and self.entry_bytes + self.assembled_bytes > budget
+            ):
+                _, (old_buf, _) = self._assembled.popitem(last=False)
+                self.assembled_bytes -= _planes_nbytes(old_buf)
+            # then evict LRU-first until under budget; pinned blocks (the
+            # head — reused by 100% of requests) are skipped, and the entry
+            # just inserted is never its own eviction victim
+            for k in list(self._entries):
+                if self.entry_bytes <= budget:
+                    break
+                if k == key or self._entries[k].pinned:
+                    continue
+                victim = self._entries.pop(k)
+                self.entry_bytes -= victim.nbytes
+                logger.debug("prefix cache evicted %r (%d bytes)", k, victim.nbytes)
+
+    def clear(self) -> None:
+        """Drop every cached block and assembled buffer (frees the HBM)."""
+        with self._lock:
+            self._entries.clear()
+            self._assembled.clear()
+            self.entry_bytes = 0
+            self.assembled_bytes = 0

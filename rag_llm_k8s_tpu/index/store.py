@@ -220,6 +220,10 @@ class VectorStore:
         self._vectors = np.zeros((0, dim), np.float32)
         self._metadata: List[Dict] = []
         self._hashes: set = set()
+        # per-row content hashes, index-aligned with _metadata: the STABLE
+        # chunk identity (survives restarts, reloads and re-ingest order)
+        # that the KV prefix cache keys segment blocks by
+        self._row_hashes: List[str] = []
         self.generation = 0
         # device snapshot: padded [cap, D] embeddings + [1, cap] squared
         # norms. IMMUTABLE pair: mutation swaps in a NEW pair (O(batch)
@@ -274,6 +278,7 @@ class VectorStore:
             self._vectors = np.concatenate([self._vectors, new_rows], axis=0)
             self._metadata.extend(fresh_m)
             self._hashes.update(fresh_h)
+            self._row_hashes.extend(fresh_h)
             # token rows fill LAZILY in token_snapshot (tokenizing here would
             # tax the ingest hot path); the live sidecar pair stays — its
             # row-coverage counter marks it stale and the next snapshot
@@ -478,6 +483,17 @@ class VectorStore:
             # adds landed mid-build: loop — the committed pair is a
             # valid n-row snapshot; the next pass splices the rest
 
+    def content_key(self, row: int) -> Optional[str]:
+        """The stable chunk identity for one store row — the content hash
+        its dedup already computes. Restart/reload-stable (derived from
+        document + chunk text, never from row order or embeddings), so the
+        KV prefix cache can key cached chunk KV blocks on it. None when
+        ``row`` is out of range."""
+        with self._lock:
+            if 0 <= row < len(self._row_hashes):
+                return self._row_hashes[row]
+            return None
+
     def cached_token_row(self, row: int) -> Optional[np.ndarray]:
         """The cached token ids for one store row (None when not yet
         tokenized or out of range) — lets the host prompt path reuse the
@@ -601,6 +617,9 @@ class VectorStore:
         # lazily (token_snapshot) once a token source is attached
         store._chunk_tokens = [None] * len(store._metadata)
         store._hashes = set(meta.get("hashes", []))
+        # per-row identities re-derive from metadata (snapshots predating
+        # the prefix cache don't persist them; content hashing is cheap)
+        store._row_hashes = [_content_hash(m) for m in store._metadata]
         store.generation = meta.get("generation", 0)
         store.fingerprint = meta.get("fingerprint", "")
         if dim is not None and store.dim != dim:

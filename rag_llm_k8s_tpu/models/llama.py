@@ -648,6 +648,7 @@ class LlamaModel(nn.Module):
         kv_len: jax.Array,
         write_index: jax.Array,
         last_logit_only: bool = False,
+        logit_index: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, KVCache]:
         c, dt = self.config, self.dtypes
         if self.quantized and c.tie_word_embeddings:
@@ -703,7 +704,15 @@ class LlamaModel(nn.Module):
         new_cache = KVCache(*new_kv)
 
         h = RMSNorm(c.rms_norm_eps, dt, name="final_norm")(h)
-        if last_logit_only:
+        if logit_index is not None:
+            # right-padded prefill (prefix-cache suffix chunks): the LAST
+            # REAL token sits at a dynamic position, not -1 — slice just it
+            # before the head projection (same [B, S, V] avoidance as
+            # last_logit_only, but at a traced index)
+            B = h.shape[0]
+            idx = jnp.clip(jnp.asarray(logit_index, jnp.int32), 0, h.shape[1] - 1)
+            h = jax.lax.dynamic_slice(h, (0, idx, 0), (B, 1, h.shape[2]))
+        elif last_logit_only:
             # prefill only consumes the final position — projecting just it
             # avoids a [B, S, V] fp32 intermediate (S x the FLOPs and HBM)
             h = h[:, -1:, :]

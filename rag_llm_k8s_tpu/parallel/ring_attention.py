@@ -60,7 +60,13 @@ def ring_attention(
     B, Sq, H, hd = q.shape
     K = k.shape[2]
     G = H // K
-    n = jax.lax.axis_size(axis_name)
+    # lax.axis_size is jax>=0.6; psum(1, axis) is the portable spelling and
+    # constant-folds to the same static int inside a shard_map trace
+    n = (
+        jax.lax.axis_size(axis_name)
+        if hasattr(jax.lax, "axis_size")
+        else jax.lax.psum(1, axis_name)
+    )
     my = jax.lax.axis_index(axis_name)
     scale = hd ** -0.5
 

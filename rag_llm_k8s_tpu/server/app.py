@@ -211,6 +211,9 @@ class RagService:
                 # assembly executables, so neither the sidecar rebuild nor
                 # an Lc-growth compile ever lands inside a user's query
                 self._warm_rag_executables(k_eff)
+                # KV prefix cache: compile this corpus's segment-KV builder
+                # bucket now, not inside the first query that misses
+                self._warm_prefix_segments()
             except Exception:  # noqa: BLE001 — warmup must not fail ingest
                 logger.exception("post-ingest retrieval warmup failed")
         self.metrics.observe("ingest_seconds", time.monotonic() - t0)
@@ -273,6 +276,11 @@ class RagService:
         ec = self.engine.engine_config
         return (
             getattr(ec, "rag_fused", False)
+            # the prefix-cache path supersedes device assembly (it needs the
+            # retrieve results host-side to resolve segments, and the KV it
+            # reuses saves more than the overlapped ids fetch) — don't build
+            # executables/sidecars the prefixed path will never consume
+            and not self._prefix_enabled()
             and isinstance(self.scheduler, BatchScheduler)
             and 0 < self.store.ntotal <= ec.rag_fused_max_vectors
         )
@@ -467,6 +475,28 @@ class RagService:
             if not results:
                 return {"generated_text": "No relevant information found in the index."}
 
+            with self._inflight_lock:
+                # this request holds one generate claim; more means a burst
+                # is in flight — bursts keep the coalesced batched path
+                # (batched decode beats serial batch-1 prefixed generates),
+                # mirroring how the single-fetch path treats bursts
+                solo = self._inflight_generate <= 1
+            if self._prefix_enabled() and solo:
+                # KV prefix cache: the head + chunk segments' KV splices
+                # from the device-resident cache and prefill touches only
+                # the per-query tail. The path bypasses the scheduler
+                # (batch-1 executable), so release the generate claim like
+                # the fused path does; on fallback, re-claim.
+                with self._inflight_lock:
+                    self._inflight_generate -= 1
+                in_generate = False
+                resp = self._answer_prefixed(user_prompt, results, timings, t_all)
+                if resp is not None:
+                    return resp
+                with self._inflight_lock:
+                    self._inflight_generate += 1
+                in_generate = True
+
             pw = (
                 self._piecewise_prompt(user_prompt, results)
                 if getattr(self.engine.engine_config, "rag_fused", False) else None
@@ -514,6 +544,91 @@ class RagService:
             "timings": {k: round(v, 2) for k, v in timings.items()},
         }
 
+    def _prefix_enabled(self) -> bool:
+        """KV prefix cache applicability (engine/prefix_cache.py)."""
+        return getattr(self.engine, "prefix_cache", None) is not None
+
+    def _warm_prefix_segments(self) -> None:
+        """AOT-compile the segment-KV builder executables for the buckets
+        queries will hit (warmup + post-ingest hook): the head's bucket and
+        a representative chunk's — reference-shaped corpora chunk uniformly,
+        so row 0's bucket is the one retrieved segments land in. Without
+        this, the first query per bucket pays the build compile inside
+        ``prefix_resolve_ms`` (measured ~1 s even at tiny scale)."""
+        if not self._prefix_enabled():
+            return
+        try:
+            from rag_llm_k8s_tpu.utils.buckets import bucket_len
+
+            pc = self.engine.engine_config.prefix_cache
+            reps = [self._a_ids()]
+            if self.store is not None and self.store.ntotal:
+                cached = self.store.cached_token_row(0)
+                if cached is not None:
+                    reps.append(list(cached))
+                else:
+                    sample = self.store.info().get("sample_chunks") or []
+                    if sample:
+                        reps.append(self._segment_ids(sample[0]))
+            seen = set()
+            for ids in reps:
+                if ids and len(ids) <= max(pc.segment_buckets):
+                    b = bucket_len(len(ids), pc.segment_buckets)
+                    if b not in seen:
+                        seen.add(b)
+                        self.engine._get_segment_kv(b)
+        except Exception:  # noqa: BLE001 — warmup must not fail boot/ingest
+            logger.exception("prefix segment warmup failed")
+
+    def _answer_prefixed(self, user_prompt: str, results, timings, t_all):
+        """The KV-prefix-cache tail of ``answer()``: resolve the canonical
+        segments against the device-resident cache (misses build + populate
+        as they go), splice the matched prefix into a fresh request cache
+        and prefill ONLY the per-query tail (engine.generate_prefixed).
+        Returns the response dict — with the per-request reuse fraction in
+        the timings block — or None when the prompt can't take the prefixed
+        path (no context room, over-capacity prefix, oversized tail); the
+        caller falls back to the ordinary paths."""
+        cache = getattr(self.engine, "prefix_cache", None)
+        if cache is None:
+            return None
+        ps = self._prompt_segments(user_prompt, results)
+        if ps is None:
+            return None
+        context, segments, b_ids = ps
+        if not b_ids:
+            return None
+        t_r = time.monotonic()
+        try:
+            cp = cache.prefix_for(segments)
+        except Exception:  # noqa: BLE001 — cache trouble must not 500 the query
+            logger.exception("prefix-cache resolve failed; host fallback")
+            return None
+        if cp is None:
+            return None
+        # hit: a dict lookup (~0); miss: the segment-build prefill — keep it
+        # out of generate_ms so the stage split stays honest either way
+        timings["prefix_resolve_ms"] = (time.monotonic() - t_r) * 1e3
+        t0 = time.monotonic()
+        try:
+            out_ids = self.engine.generate_prefixed(b_ids, cp)
+        except ValueError:
+            return None  # tail over the suffix ladder: cold path serves
+        completion = self.llm_tokenizer.decode(out_ids)
+        timings["generate_ms"] = (time.monotonic() - t0) * 1e3
+        total_prompt = cp.length + len(b_ids)
+        timings["prefix_reuse_frac"] = cp.reused_tokens / max(total_prompt, 1)
+        timings["prefill_tokens_skipped"] = float(cp.reused_tokens)
+        timings["total_ms"] = (time.monotonic() - t_all) * 1e3
+        self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
+        self.metrics.inc("query_decode_tokens", len(out_ids))
+        self.metrics.inc("query_prefix_cached", 1)
+        return {
+            "generated_text": extract_answer(completion),
+            "context": context,
+            "timings": {k: round(v, 2) for k, v in timings.items()},
+        }
+
     def _answer_fused(self, user_prompt: str, fused_r, timings, t_all):
         """The single-fetch tail of ``answer()``: device-side prompt assembly
         + generate from the unfetched retrieve handle (engine.generate_rag),
@@ -521,6 +636,13 @@ class RagService:
         generation on a side thread. Returns the response dict, or None when
         the prompt head + tail can't fit the bucket (caller falls back to
         the host path, which can chunk-prefill)."""
+        if self._prefix_enabled():
+            # cache lookup wins over device assembly: the prefixed path
+            # reuses cached KV for the head + hot chunks, which saves far
+            # more prefill than the overlapped ids fetch saves tunnel time.
+            # Yield so answer() materializes the retrieve results and takes
+            # the prefixed tail (falling back further if that can't serve).
+            return None
         _, packed_dev, k_eff, tokenize_ms = fused_r
         t_b = time.monotonic()
         b_ids = self._b_ids(user_prompt)
@@ -595,14 +717,18 @@ class RagService:
             "timings": {k: round(v, 2) for k, v in timings.items()},
         }
 
-    def _piecewise_prompt(self, user_prompt: str, results):
-        """Host-side mirror of the device prompt assembly (rag_fused mode):
-        piecewise token concatenation — head ‖ kept chunk segments ‖ tail —
-        under the SAME budget rule (keep the longest chunk prefix that fits;
-        token-truncate the first chunk if it alone overflows), so batched
-        host answers are token-identical to solo device answers. Returns
-        None when head + tail leave no context room (legacy budgeted path
-        handles it, including chunked prefill)."""
+    def _prompt_segments(self, user_prompt: str, results):
+        """THE canonical prompt-segment layout: ``(context, segments,
+        b_ids)`` where ``segments = [(stable_key, token_ids), ...]`` is the
+        head followed by the kept chunk segments, under the budget rule
+        (``_kept_chunks``). Chunk boundaries are fixed by this one function
+        for every serving path — host piecewise assembly, the device
+        assembly's host mirror AND the KV prefix cache (whose blocks are
+        keyed ``(stable_key, position_slot)``, so alignment across requests
+        is what makes reuse fire). Keys come from the store's content hash
+        (restart-stable); a budget-truncated first chunk gets a distinct
+        key — its KV is a different token stream. Returns None when head +
+        tail leave no context room."""
         a_ids = self._a_ids()
         b_ids = self._b_ids(user_prompt)
         S = max(self.engine.engine_config.prompt_buckets)
@@ -611,26 +737,51 @@ class RagService:
             return None
         top_n = self.config.retrieval.context_top_n
         segs: List[List[int]] = []
+        keys: List[str] = []
         for r in results[:top_n]:
             # reuse the sidecar's cached tokenization when the result carries
             # its store row (avoids re-encoding multi-hundred-token segments
             # on every batched request)
+            row = getattr(r, "row", -1)
             cached = (
-                self.store.cached_token_row(getattr(r, "row", -1))
+                self.store.cached_token_row(row)
                 if self.store is not None else None
             )
             segs.append(
                 list(cached) if cached is not None else self._segment_ids(r.metadata)
             )
+            ck = self.store.content_key(row) if self.store is not None else None
+            keys.append(
+                f"chunk:{ck}" if ck is not None
+                else f"chunk:anon:{hash(tuple(segs[-1])) & 0xFFFFFFFFFFFF:012x}"
+            )
         n_kept, _, trunc = self._kept_chunks([len(s) for s in segs], avail)
         kept = segs[:n_kept]
+        kept_keys = keys[:n_kept]
         if trunc is not None:
             kept[0] = kept[0][:trunc]
-        ids = list(a_ids)
-        for seg in kept:
+            kept_keys[0] = f"{kept_keys[0]}:t{trunc}"
+        segments = [(f"head:{len(a_ids)}", list(a_ids))]
+        segments.extend(zip(kept_keys, kept))
+        context = assemble_context(results, n_kept)
+        return context, segments, b_ids
+
+    def _piecewise_prompt(self, user_prompt: str, results):
+        """Host-side mirror of the device prompt assembly (rag_fused mode):
+        piecewise token concatenation — head ‖ kept chunk segments ‖ tail —
+        under the SAME budget rule (keep the longest chunk prefix that fits;
+        token-truncate the first chunk if it alone overflows), so batched
+        host answers are token-identical to solo device answers. Returns
+        None when head + tail leave no context room (legacy budgeted path
+        handles it, including chunked prefill)."""
+        ps = self._prompt_segments(user_prompt, results)
+        if ps is None:
+            return None
+        context, segments, b_ids = ps
+        ids: List[int] = []
+        for _, seg in segments:
             ids.extend(seg)
         ids.extend(b_ids)
-        context = assemble_context(results, n_kept)
         return context, ids
 
     @staticmethod
@@ -786,6 +937,19 @@ class RagService:
             # single-fetch serving: sidecar + generate_rag executables warm
             # here too — the first production solo query must not compile
             self._warm_rag_executables(min(self.config.retrieval.k, self.store.ntotal))
+        if self._prefix_enabled():
+            # KV prefix cache: compute + PIN the fixed head block (reused by
+            # 100% of requests — it must never evict) and AOT-compile the
+            # prefixed generate executables, so a cache hit never compiles
+            # or prefills the head inside a user's request
+            try:
+                head_key = f"head:{len(self._a_ids())}"
+                self.engine.prefix_cache.pin(head_key)
+                self.engine.prefix_cache.prefix_for([(head_key, self._a_ids())])
+                self.engine.warm_prefixed()
+                self._warm_prefix_segments()
+            except Exception:  # noqa: BLE001 — warmup must not fail boot
+                logger.exception("prefix-cache warmup failed")
         self.ready = True
 
     def shutdown(self):
@@ -898,6 +1062,10 @@ class WsgiApp:
             spec_emitted_tokens=sum(
                 getattr(e.stats, "spec_emitted_tokens", 0) for e in engines.values()
             ),
+            prefill_tokens_skipped=sum(
+                getattr(e.stats, "prefill_tokens_skipped", 0)
+                for e in engines.values()
+            ),
         )
         snap.update(
             {
@@ -908,9 +1076,20 @@ class WsgiApp:
                 # spec_verify_steps = measured acceptance (tokens/verify)
                 "engine_spec_verify_steps": stats.spec_verify_steps,
                 "engine_spec_emitted_tokens": stats.spec_emitted_tokens,
+                # KV prefix cache: prompt tokens whose prefill was skipped
+                # because their KV spliced from a cached block — computed
+                # (engine_prefill_tokens) + skipped = logical prompt total
+                "prefill_tokens_skipped": stats.prefill_tokens_skipped,
                 "index_vectors": self.service.store.ntotal,
             }
         )
+        for e in engines.values():
+            pcache = getattr(e, "prefix_cache", None)
+            if pcache is not None:
+                for key, val in pcache.counters().items():
+                    if key == "prefill_tokens_skipped":
+                        continue  # the engine-stat sum above already has it
+                    snap[key] = snap.get(key, 0) + val
         # Prometheus text exposition by default so a scraper can actually
         # consume this (survey §5); the JSON shape stays available under
         # Accept: application/json for humans and the existing tests
@@ -920,9 +1099,9 @@ class WsgiApp:
 
         lines = []
         # everything _Metrics records is monotonic (inc/observe only ever
-        # add); the only level-valued sample in the snapshot is the live
-        # index size
-        gauges = {"index_vectors"}
+        # add); the level-valued samples are the live index size and the
+        # prefix cache's current occupancy
+        gauges = {"index_vectors", "prefix_cache_entries", "prefix_cache_bytes"}
         for key in sorted(snap):
             name = "tpu_rag_" + _re.sub(r"[^a-zA-Z0-9_]", "_", str(key))
             kind = "gauge" if key in gauges else "counter"

@@ -27,6 +27,15 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def set_mesh(mesh):
+    """Ambient-mesh context, version-portable: ``jax.set_mesh`` on jax>=0.7,
+    entering the Mesh itself (the historical spelling with the same
+    axis-name-resolution semantics for traced collectives) before that."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()
