@@ -4,6 +4,7 @@
 #   make tpu-test  - hardware lane on the real TPU chip (kernels vs oracles,
 #                    engine end-to-end); skips itself when no TPU is present
 #   make bench     - headline benchmark JSON line (real chip)
+#   make lint      - ruff (when available) + metrics↔OBSERVABILITY.md gate
 #   make check     - THE pre-snapshot gate: everything the driver measures.
 #                    Run before every snapshot commit; nothing ships red.
 
@@ -33,6 +34,19 @@ tpu-test:
 bench:
 	python bench.py
 
+# Static checks: ruff (when the environment provides it — this container
+# does not bake it in, and the no-new-deps rule forbids installing it here)
+# plus the metrics↔docs consistency gate: every metric name registered in
+# code must appear in docs/OBSERVABILITY.md (scripts/check_metrics_docs.py,
+# stdlib-only so it runs everywhere tier1 runs).
+lint:
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check rag_llm_k8s_tpu tests bench.py scripts; \
+	else \
+		echo "lint: ruff not installed in this environment; skipping style pass"; \
+	fi
+	python scripts/check_metrics_docs.py
+
 validate-8b:
 	python scripts/validate_8b.py
 
@@ -48,4 +62,4 @@ check: test tpu-test bench
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8); print('dryrun_multichip(8): OK')"
 
-.PHONY: test tier1 tpu-test bench check validate-8b validate-70b
+.PHONY: test tier1 tpu-test bench lint check validate-8b validate-70b

@@ -455,11 +455,27 @@ def measure_query_e2e() -> dict:
         def sf_count():
             return int(service.metrics.snapshot().get("query_single_fetch", 0))
 
+        # the p50/p95 this leg SHIPS are read from the service's own
+        # rag_request_duration_seconds histogram (obs/metrics.py) — the
+        # exact structure a production Prometheus scrapes — diffed around
+        # each pass so the winning pass's window is what's quantiled. The
+        # client wall-clock list is still collected (pass selection + the
+        # *_client_ms continuity fields).
+        req_hist = service.metrics.histogram("rag_request_duration_seconds")
+
+        def hist_diff(after, before):
+            return (
+                tuple(a - b for a, b in zip(after[0], before[0])),
+                after[1] - before[1],
+                after[2] - before[2],
+            )
+
         pass_runs = []
         for p in range(max(1, solo_passes)):
             if p:
                 time.sleep(45)
             sf0 = sf_count()
+            h0 = req_hist.snapshot()
             p_lat: list = []
             p_stages = {k: [] for k in stages}
             for q in jobs:
@@ -472,13 +488,17 @@ def measure_query_e2e() -> dict:
                     p_stages[k].append(body["timings"][k])
             p_lat.sort()
             pass_runs.append(
-                (p_lat[len(p_lat) // 2], p_lat, p_stages, sf_count() - sf0)
+                (p_lat[len(p_lat) // 2], p_lat, p_stages, sf_count() - sf0,
+                 hist_diff(req_hist.snapshot(), h0))
             )
         service.shutdown()
         best = min(pass_runs, key=lambda t: t[0])
         lat_ms, stages = best[1], best[2]
         snap = _spec_snapshot(engine, service)
         snap["single_fetch"] = best[3]  # the WINNING pass's own count
+        for q, field in ((0.5, "hist_p50_ms"), (0.95, "hist_p95_ms")):
+            v = req_hist.quantile(q, best[4])
+            snap[field] = round(v * 1e3, 1) if v is not None else None
         if solo_passes > 1:
             snap["solo_passes"] = [round(t[0], 1) for t in pass_runs]
         return lat_ms, stages, ingest_s, snap
@@ -517,9 +537,9 @@ def measure_query_e2e() -> dict:
 
     cfg_1b = LlamaConfig.llama_3_2_1b()
     params_1b = make_params(cfg_1b, "bf16")
-    lat_ms, stages, ingest_s, _ = run_mode(cfg_1b, params_1b, "bf16", ingest=True)
+    lat_ms, stages, ingest_s, snap_1b = run_mode(cfg_1b, params_1b, "bf16", ingest=True)
     params_1b_q = make_params(cfg_1b, "int8")
-    lat_int8, _, _, _ = run_mode(cfg_1b, params_1b_q, "int8", ingest=False)
+    lat_int8, _, _, snap_int8 = run_mode(cfg_1b, params_1b_q, "int8", ingest=False)
     # the judged under-load leg serves the PRODUCTION config — int8
     # weights + int8 KV, exactly what deploy.yaml pins for serving
     # (RUNBOOK §8); bf16 stays measured solo above (numerics-exact).
@@ -562,7 +582,7 @@ def measure_query_e2e() -> dict:
     # the A/B stays symmetric: the spec-off leg gets the same two-pass
     # min-of-N treatment, or contention dodged only by the spec-on leg
     # would overstate what speculation buys
-    lat_8b_off, _, _, _ = run_mode(
+    lat_8b_off, _, _, snap_8b_off = run_mode(
         cfg_8b, params_8b, "int8", ingest=False, kv_quant="int8",
         n_queries=6, speculative="off", solo_passes=2,
     )
@@ -616,10 +636,35 @@ def measure_query_e2e() -> dict:
     # the 8B solo adj subtracts the MEASURED fetch count, not an assumption:
     # a silent host-path fallback (sidecar failure, oversized tail) pays 2
     fetches_8b = 1 if spec_8b.get("single_fetch", 0) >= len(lat_8b) else 2
+
+    def hist_or(snap, field, fallback):
+        """Solo p50/p95 ship from the service's request-duration histogram
+        (same structure a production scrape reads — ISSUE 2). Histogram
+        quantiles interpolate inside a log-spaced bucket (REQUEST_BUCKETS,
+        ~12% ratio), so EVERY switched key also ships an exact *_client_ms
+        wall-clock companion below — cross-round comparisons and
+        target-margin judgments must read those."""
+        v = snap.get(field)
+        return v if v is not None else round(fallback, 1)
+
+    p50_client = round(lat_ms[n // 2], 1)
+    p95_client = round(lat_ms[max(0, math.ceil(n * 0.95) - 1)], 1)
+    p50_8b_client = round(lat_8b[len(lat_8b) // 2], 1)
+    p95_8b_client = round(lat_8b[max(0, math.ceil(len(lat_8b) * 0.95) - 1)], 1)
+    p50_8b = hist_or(spec_8b, "hist_p50_ms", p50_8b_client)
     return {
-        "query_p50_ms": round(lat_ms[n // 2], 1),
-        "query_p95_ms": round(lat_ms[max(0, math.ceil(n * 0.95) - 1)], 1),
-        "query_p50_int8_ms": round(lat_int8[len(lat_int8) // 2], 1),
+        "query_p50_ms": hist_or(snap_1b, "hist_p50_ms", lat_ms[n // 2]),
+        "query_p95_ms": hist_or(snap_1b, "hist_p95_ms", p95_client),
+        # client wall-clock (the pre-obs source, exact): continuity fields
+        # for every histogram-sourced key — the headline reads the
+        # server-side histogram, the judgment against the <2 s target and
+        # any cross-round delta read these
+        "query_p50_client_ms": p50_client,
+        "query_p95_client_ms": p95_client,
+        "query_p50_int8_ms": hist_or(
+            snap_int8, "hist_p50_ms", lat_int8[len(lat_int8) // 2]
+        ),
+        "query_p50_int8_client_ms": round(lat_int8[len(lat_int8) // 2], 1),
         # aggregate serving throughput: concurrent requests coalesced into
         # batched generates — the reference serves strictly one-at-a-time
         # (rag.py:204), so its qps is 1 / its per-query latency
@@ -647,13 +692,14 @@ def measure_query_e2e() -> dict:
         "query_stage_ms": stage_means(stages),
         "query_n": n,
         # ---- flagship: the model the reference serves (8B), int8 w+kv ----
-        "query_p50_8b_ms": round(lat_8b[len(lat_8b) // 2], 1),
-        "query_p95_8b_ms": round(
-            lat_8b[max(0, math.ceil(len(lat_8b) * 0.95) - 1)], 1
-        ),
-        "query_p50_8b_adj_ms": round(
-            lat_8b[len(lat_8b) // 2] - fetches_8b * tunnel_ms, 1
-        ),
+        "query_p50_8b_ms": p50_8b,
+        "query_p95_8b_ms": hist_or(spec_8b, "hist_p95_ms", p95_8b_client),
+        "query_p50_8b_client_ms": p50_8b_client,
+        "query_p95_8b_client_ms": p95_8b_client,
+        # adj stays on the EXACT client base (the arithmetic rounds <= 5
+        # judged): subtracting measured tunnel fetches from an interpolated
+        # histogram estimate would stack two error sources
+        "query_p50_8b_adj_ms": round(p50_8b_client - fetches_8b * tunnel_ms, 1),
         "query_8b_fetches_per_query": fetches_8b,  # measured via metrics
         # two solo passes ~45 s apart; headline = the better (min-of-N
         # discipline, same as the burst legs); both p50s recorded
@@ -666,7 +712,10 @@ def measure_query_e2e() -> dict:
         # top-1 prob at T=0.7 after calibration)
         "query_8b_tokens_per_verify": spec_8b["tokens_per_verify"],
         "query_8b_spec_verify_steps": spec_8b["verify_steps"],
-        "query_p50_8b_nospec_ms": round(lat_8b_off[len(lat_8b_off) // 2], 1),
+        "query_p50_8b_nospec_ms": hist_or(
+            snap_8b_off, "hist_p50_ms", lat_8b_off[len(lat_8b_off) // 2]
+        ),
+        "query_p50_8b_nospec_client_ms": round(lat_8b_off[len(lat_8b_off) // 2], 1),
         "query_8b_logit_alpha": alpha_8b,
         "query_8b_top1_prob": top1_8b,
         "query_qps_8b_load": round(load_8b["qps"], 2),
@@ -681,7 +730,10 @@ def measure_query_e2e() -> dict:
         # computed + reused = logical prompt tokens across the leg; the
         # reduction field is the fraction of prompt prefill the cache
         # removed (head + hot chunks spliced from device-resident KV)
-        "query_p50_prefix_ms": round(lat_px[len(lat_px) // 2], 1),
+        "query_p50_prefix_ms": hist_or(
+            px_snap, "hist_p50_ms", lat_px[len(lat_px) // 2]
+        ),
+        "query_p50_prefix_client_ms": round(lat_px[len(lat_px) // 2], 1),
         "prefix_prefill_tokens_computed": px_snap["prefill_tokens_computed"],
         "prefix_prefill_tokens_reused": px_snap["prefill_tokens_reused"],
         "prefix_prefill_reduction": round(

@@ -33,6 +33,7 @@ class _Pending:
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[List[int]] = None
     error: Optional[BaseException] = None
+    t_enqueue: float = field(default_factory=time.monotonic)  # wait anchor
 
 
 @dataclass
@@ -41,6 +42,7 @@ class _PendingItem:
     done: threading.Event = field(default_factory=threading.Event)
     result: object = None
     error: Optional[BaseException] = None
+    t_enqueue: float = field(default_factory=time.monotonic)  # wait anchor
 
 
 class Coalescer:
@@ -81,6 +83,10 @@ class Coalescer:
         self.max_wait_ms = max_wait_ms
         self.pending_hint = pending_hint
         self.hint_grace_ms = hint_grace_ms
+        # optional obs Histogram (settable after construction, like
+        # pending_hint): per-item enqueue→dispatch wait — the coalesce
+        # window's real cost per request on a dashboard
+        self.wait_histogram = None
         self._queue: "queue.Queue[_PendingItem]" = queue.Queue()
         self._stop = threading.Event()
         self._lifecycle_lock = threading.Lock()
@@ -152,6 +158,11 @@ class Coalescer:
                     if nxt is None:
                         break
                     batch.append(nxt)
+                hist = self.wait_histogram
+                if hist is not None:
+                    now = time.monotonic()
+                    for b in batch:
+                        hist.observe(now - b.t_enqueue)
                 try:
                     results = self.batch_fn([b.value for b in batch])
                     if len(results) != len(batch):
@@ -193,6 +204,12 @@ class BatchScheduler:
         self.engine = engine
         self.max_wait_ms = max_wait_ms
         self.pending_hint = pending_hint
+        # optional obs Histogram — see Coalescer.wait_histogram
+        self.wait_histogram = None
+        # size of the batch currently inside engine.generate (0 between
+        # dispatches) — the rag_batch_occupancy gauge reads this; plain
+        # int assignment, so no lock needed for the scrape-time read
+        self.in_flight = 0
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         self._stop = threading.Event()
         # serializes submit's stop-check+enqueue against shutdown's final
@@ -294,6 +311,12 @@ class BatchScheduler:
                     # could starve it under sustained mixed load)
                     carry = nxt
                     break
+            hist = self.wait_histogram
+            if hist is not None:
+                now = time.monotonic()
+                for b in batch:
+                    hist.observe(now - b.t_enqueue)
+            self.in_flight = len(batch)
             try:
                 outs = self.engine.generate(
                     [b.prompt for b in batch],
@@ -306,6 +329,7 @@ class BatchScheduler:
                 for b in batch:
                     b.error = e
             finally:
+                self.in_flight = 0
                 for b in batch:
                     b.done.set()
         return carry

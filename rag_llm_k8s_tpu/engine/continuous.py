@@ -34,6 +34,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -57,6 +58,7 @@ from rag_llm_k8s_tpu.engine.engine import (
 )
 from rag_llm_k8s_tpu.engine.sampling import sample_token_per_row
 from rag_llm_k8s_tpu.models.llama import LlamaModel, make_kv_cache, mask_window
+from rag_llm_k8s_tpu.obs import metrics as obs_metrics
 from rag_llm_k8s_tpu.utils.buckets import bucket_len
 
 logger = logging.getLogger(__name__)
@@ -145,6 +147,33 @@ class ContinuousEngine:
         self.slots = [_Slot() for _ in range(self.B)]
         self.steps = 0  # global decode steps executed (tests/metrics)
         self.stats = EngineStats()  # /metrics parity with InferenceEngine
+        # observability handles (obs/metrics.py): standalone engines report
+        # into the process default registry; RagService rebinds to its own
+        self.bind_metrics(obs_metrics.default_registry())
+
+    def bind_metrics(self, registry) -> None:
+        """Point this engine's metric handles at ``registry``. Unlike the
+        one-shot engine, the slot engine's host loop sees real per-request
+        and per-window boundaries, so TTFT and inter-token latency here are
+        measured EXACTLY (admission → first token; step window / k)."""
+        self._obs = registry
+        self._m_compile_events = registry.counter(
+            "rag_compile_events_total", "AOT lowering/compile events"
+        )
+        self._m_compile_seconds = registry.counter(
+            "rag_compile_seconds_total", "seconds spent in AOT lowering/compile"
+        )
+        self._m_ttft = registry.histogram(
+            "rag_time_to_first_token_seconds",
+            "submit-to-first-token (queue + coalesce + prefill + fetch)",
+            buckets=obs_metrics.REQUEST_BUCKETS,
+        )
+        self._m_itl = registry.labeled_histogram(
+            "rag_decode_inter_token_seconds",
+            "per-decoded-token latency (mode label: oneshot_est is call "
+            "duration over decode steps; continuous is exact per window)",
+            buckets=obs_metrics.TOKEN_LATENCY_BUCKETS,
+        ).labels(mode="continuous")
 
     def warmup(self, batch_sizes=None, buckets=None):
         """AOT-compile every executable serving will hit (readiness gating).
@@ -216,6 +245,7 @@ class ContinuousEngine:
         key = (kind, S, n)
         fn = self._compiled.get(key)
         if fn is None:
+            t0 = time.perf_counter()
             if kind == "step":
                 fn = self._build_step(S)  # S carries the sync window here
             elif kind == "prefill":
@@ -224,6 +254,8 @@ class ContinuousEngine:
                 fn = self._build_prefill_prefixed(S, n)  # n carries the suffix bucket
             else:
                 fn = self._build_insert(S, n)
+            self._m_compile_events.inc()
+            self._m_compile_seconds.inc(time.perf_counter() - t0)
             self._compiled[key] = fn
         return fn
 
@@ -778,6 +810,7 @@ class ContinuousEngine:
         device call + one host fetch. Returns completed requests as
         ``(request_id, tokens)`` and frees their slots."""
         k = self.sync_steps
+        t0 = time.perf_counter()
         (self._cache, self._kv_len, self._last_tok, toks, eoss,
          self._active) = self._get("step", k)(
             self.params, self._cache, self._kv_start,
@@ -785,6 +818,10 @@ class ContinuousEngine:
         )
         self.steps += k
         tok_h = np.asarray(toks)  # [k, B]
+        # EXACT inter-token latency: one sync window (device step + the
+        # token-plane fetch) amortized over its k steps — every active row
+        # advanced k tokens in this wall-clock interval
+        self._m_itl.observe((time.perf_counter() - t0) / k)
         eos_h = np.asarray(eoss)
         done: List[Tuple[int, List[int]]] = []
         deactivate = []
@@ -953,6 +990,10 @@ class ContinuousScheduler:
                             b.done.set()
                             continue
                         _, finished = res
+                        # the first token exists the moment admission
+                        # returns (sampled at prefill): submit → here IS
+                        # the request's exact TTFT, queue wait included
+                        eng._m_ttft.observe(time.monotonic() - b.t_submit)
                         if finished is not None:
                             b.result = finished
                             b.done.set()
@@ -1011,3 +1052,4 @@ class _Pending:
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[List[int]] = None
     error: Optional[BaseException] = None
+    t_submit: float = field(default_factory=time.monotonic)  # TTFT anchor

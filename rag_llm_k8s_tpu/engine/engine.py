@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -49,6 +50,7 @@ from rag_llm_k8s_tpu.models.llama import (
     make_kv_cache,
     mask_window,
 )
+from rag_llm_k8s_tpu.obs import metrics as obs_metrics
 from rag_llm_k8s_tpu.utils.buckets import bucket_len, next_pow2
 
 logger = logging.getLogger(__name__)
@@ -209,6 +211,9 @@ class InferenceEngine:
         self._lock = threading.Lock()
         self._rng_counter = 0
         self.stats = EngineStats()
+        # observability handles (obs/metrics.py): standalone engines report
+        # into the process default registry; RagService rebinds to its own
+        self.bind_metrics(obs_metrics.default_registry())
         # cross-request KV prefix cache (engine/prefix_cache.py): owns the
         # HBM-budgeted LRU of segment blocks; this engine provides the
         # build/splice/generate executables it drives
@@ -219,6 +224,47 @@ class InferenceEngine:
             from rag_llm_k8s_tpu.engine.prefix_cache import PrefixCache
 
             self.prefix_cache = PrefixCache(engine_config.prefix_cache, self)
+
+    # ------------------------------------------------------------------
+    # observability (obs/metrics.py)
+    # ------------------------------------------------------------------
+    def bind_metrics(self, registry) -> None:
+        """Point this engine's metric handles at ``registry`` — called at
+        construction with the process default and again by RagService with
+        the service's own registry, so one scrape carries the engine's
+        compile events and generate/inter-token histograms."""
+        self._obs = registry
+        self._m_compile_events = registry.counter(
+            "rag_compile_events_total", "AOT lowering/compile events"
+        )
+        self._m_compile_seconds = registry.counter(
+            "rag_compile_seconds_total", "seconds spent in AOT lowering/compile"
+        )
+        self._m_generate = registry.histogram(
+            "rag_generate_duration_seconds",
+            "one generate call: prefill + decode + output fetch",
+            buckets=obs_metrics.REQUEST_BUCKETS,
+        )
+        # the one-shot engine's whole generate is ONE device program, so
+        # its per-token figure is an ESTIMATE (call duration / decode
+        # steps, prefill share included) — labeled to distinguish it from
+        # the continuous engine's exact per-window measurement
+        self._m_itl = registry.labeled_histogram(
+            "rag_decode_inter_token_seconds",
+            "per-decoded-token latency (mode label: oneshot_est is call "
+            "duration over decode steps; continuous is exact per window)",
+            buckets=obs_metrics.TOKEN_LATENCY_BUCKETS,
+        ).labels(mode="oneshot_est")
+
+    def _record_compile(self, seconds: float) -> None:
+        """Attribute one AOT lowering/compile to the dashboard ('first
+        request is slow' becomes a visible compile event, not a mystery)."""
+        self._m_compile_events.inc()
+        self._m_compile_seconds.inc(seconds)
+
+    def _observe_generate(self, seconds: float, decode_steps: int) -> None:
+        self._m_generate.observe(seconds)
+        self._m_itl.observe(seconds / max(decode_steps, 1))
 
     # ------------------------------------------------------------------
     # compiled generate graph (one per (B, S, max_new))
@@ -660,12 +706,14 @@ class InferenceEngine:
                 jax.device_put(x, rep) for x in (a_j, b_j, blen_j, packed, rng)
             )
             store_toks, store_lens = self._placed_sidecar(store_toks, store_lens)
+        t_call = time.perf_counter()
         out = np.asarray(
             fn(
                 self.params, a_j, b_j, blen_j, packed, store_toks, store_lens,
                 rng_j,
             )
         )  # the ONE per-query fetch
+        call_s = time.perf_counter() - t_call
         iters = 0
         if spec:
             iters = int(out[0, max_new])
@@ -679,6 +727,7 @@ class InferenceEngine:
         if spec and iters > 0:
             emitted = len(row) + (1 if len(row) < max_new else 0) - 1
             self._spec_record(max(emitted, 0), iters)
+        self._observe_generate(call_s, len(row))
         with self._lock:
             self.stats.generate_calls += 1
             self.stats.decode_tokens += len(row)
@@ -705,7 +754,9 @@ class InferenceEngine:
             with self._lock:
                 built = self._compiled.get(key)
             if built is None:
+                t0 = time.perf_counter()
                 built = self._build_generate_rag(S, max_new, cap, Lc, LA, LB, n, kk, v)
+                self._record_compile(time.perf_counter() - t0)
                 with self._lock:
                     self._compiled.setdefault(key, built)
                     built = self._compiled[key]
@@ -842,7 +893,9 @@ class InferenceEngine:
         with self._lock:
             fn = self._compiled.get(key)
         if fn is None:
+            t0 = time.perf_counter()
             fn = self._build_segment_kv(Sb)
+            self._record_compile(time.perf_counter() - t0)
             with self._lock:
                 self._compiled.setdefault(key, fn)
                 fn = self._compiled[key]
@@ -1031,7 +1084,9 @@ class InferenceEngine:
         with self._lock:
             fn = self._compiled.get(key)
         if fn is None:
+            t0 = time.perf_counter()
             fn = self._build_generate_prefixed(S_suf, max_new)
+            self._record_compile(time.perf_counter() - t0)
             with self._lock:
                 self._compiled.setdefault(key, fn)
                 fn = self._compiled[key]
@@ -1048,13 +1103,16 @@ class InferenceEngine:
                 jax.device_put(x, rep) for x in (toks_j, plen_j, slen_j, rng)
             )
             planes = tuple(jax.device_put(p, rep) for p in planes)
+        t_call = time.perf_counter()
         out = np.asarray(fn(self.params, planes, plen_j, toks_j, slen_j, rng))
+        call_s = time.perf_counter() - t_call
         eos = set(self.config.eos_token_ids)
         row: List[int] = []
         for t in out[0]:
             if int(t) in eos:
                 break
             row.append(int(t))
+        self._observe_generate(call_s, len(row))
         with self._lock:
             self.stats.generate_calls += 1
             self.stats.prefill_tokens += len(suffix_ids)
@@ -1089,7 +1147,9 @@ class InferenceEngine:
             with self._lock:
                 built = key in self._compiled
             if not built:
+                t0 = time.perf_counter()
                 fn = self._build_generate_prefixed(S_suf, max_new)
+                self._record_compile(time.perf_counter() - t0)
                 with self._lock:
                     self._compiled.setdefault(key, fn)
 
@@ -1100,10 +1160,12 @@ class InferenceEngine:
         with self._lock:
             fn = self._compiled.get(key)
         if fn is None:
+            t0 = time.perf_counter()
             if chunk == "spec":
                 fn = self._build_generate_spec(S, max_new)
             else:
                 fn = self._build_generate(B, S, max_new, chunk)
+            self._record_compile(time.perf_counter() - t0)
             with self._lock:
                 self._compiled.setdefault(key, fn)
                 fn = self._compiled[key]
@@ -1255,12 +1317,14 @@ class InferenceEngine:
         fn = self._get_compiled(B, S, max_new, "spec" if spec else chunk)
         tokens_j, mask_j, rng_j = self._place_inputs(tokens, pad_mask, rng)
         iters = 0
+        t_call = time.perf_counter()
         if spec:
             out = np.asarray(fn(self.params, tokens_j, mask_j, rng_j))  # ONE fetch
             iters = int(out[0, max_new])  # packed in the slack slot
             out = out[:, :max_new]
         else:
             out = np.asarray(fn(self.params, tokens_j, mask_j, rng_j))
+        call_s = time.perf_counter() - t_call
 
         results: List[List[int]] = []
         eos = set(self.config.eos_token_ids)
@@ -1280,6 +1344,7 @@ class InferenceEngine:
             # /metrics counters
             emitted = len(results[0]) + (1 if len(results[0]) < max_new else 0) - 1
             self._spec_record(max(emitted, 0), int(iters))
+        self._observe_generate(call_s, max((len(r) for r in results), default=1))
         with self._lock:
             self.stats.generate_calls += 1
             self.stats.prefill_tokens += int(pad_mask.sum())

@@ -1,0 +1,19 @@
+"""Observability: metrics registry (Prometheus exposition) + request tracing.
+
+Three pillars (ISSUE 2):
+
+- ``obs.metrics`` — typed Counter/Gauge/Histogram primitives in a
+  :class:`~rag_llm_k8s_tpu.obs.metrics.MetricsRegistry`, rendered in
+  Prometheus text exposition format (and a flat JSON snapshot for the
+  legacy ``/metrics`` consumers);
+- ``obs.tracing`` — contextvar-propagated per-request span trees, kept in
+  an in-memory ring buffer (``/debug/traces``) and returned inline for
+  ``{"trace": true}`` queries; spans wrap device work in
+  ``jax.profiler.TraceAnnotation`` so xprof captures show named stages;
+- engine instrumentation (TTFT / inter-token / occupancy / compile time)
+  lives at the call sites in ``engine/`` and ``server/`` and reports into
+  the registry.
+"""
+
+from rag_llm_k8s_tpu.obs.metrics import MetricsRegistry, default_registry  # noqa: F401
+from rag_llm_k8s_tpu.obs.tracing import TraceBuffer, span, start_trace  # noqa: F401

@@ -1,0 +1,216 @@
+"""Request-scoped tracing: contextvar-propagated span trees per request.
+
+Every ``/generate`` request gets a trace id and a tree of stage spans
+(tokenize → retrieve-coalesce wait → fused embed+kNN → prefix resolve →
+prefill+decode → detokenize). Spans are recorded in the request thread at
+the same boundaries the response's ``timings`` block is measured at, so
+the span durations and the timings agree by construction (the acceptance
+contract: top-level spans sum to within 5% of ``timings.total_ms``).
+
+Where a stage runs as ONE fused device program (the whole generate loop is
+a single executable — by design, see engine/engine.py), the host cannot
+observe finer structure wall-clock; those stages appear as one span and
+their interior is visible two other ways instead:
+
+- every span body is wrapped in ``jax.profiler.TraceAnnotation``, so an
+  xprof capture (``/profile``) shows the named stages on the device
+  timeline;
+- the per-token view (TTFT / inter-token) comes from the metrics
+  histograms the engines feed (``rag_time_to_first_token_seconds``,
+  ``rag_decode_inter_token_seconds``) — distribution over all traffic
+  rather than one request's timeline.
+
+Finished traces are emitted as structured JSON logs (logger
+``rag_llm_k8s_tpu.trace``, DEBUG) and kept in an in-memory ring buffer
+served by ``GET /debug/traces``; a client posting ``{"trace": true}`` gets
+its own tree inline in the response.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import threading
+import time
+import uuid
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("rag_llm_k8s_tpu.trace")
+
+try:  # device-timeline names for xprof captures; absent off-JAX is fine
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:  # noqa: BLE001 — tracing must work without jax
+    _TraceAnnotation = None
+
+
+@dataclass
+class Span:
+    name: str
+    start_s: float  # monotonic
+    end_s: Optional[float] = None
+    parent: Optional[int] = None  # index into Trace.spans
+    attrs: Dict[str, float] = field(default_factory=dict)
+
+    def duration_ms(self) -> float:
+        return ((self.end_s if self.end_s is not None else self.start_s)
+                - self.start_s) * 1e3
+
+
+class Trace:
+    """One request's span tree. NOT thread-safe on purpose: a trace belongs
+    to the request thread that started it (contextvar propagation); stages
+    that run on worker threads are accounted for by the request-thread span
+    that waits on them (e.g. retrieve-coalesce wait)."""
+
+    def __init__(self, trace_id: Optional[str] = None):
+        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        self.started_at = time.time()
+        self.t0 = time.monotonic()
+        self.end_s: Optional[float] = None
+        self.spans: List[Span] = []
+        self._stack: List[int] = []  # open span indices (nesting)
+        self.attrs: Dict[str, object] = {}
+
+    # -- recording -------------------------------------------------------
+    def begin(self, name: str) -> int:
+        parent = self._stack[-1] if self._stack else None
+        self.spans.append(Span(name, time.monotonic(), parent=parent))
+        idx = len(self.spans) - 1
+        self._stack.append(idx)
+        return idx
+
+    def end(self, idx: int) -> None:
+        self.spans[idx].end_s = time.monotonic()
+        if self._stack and self._stack[-1] == idx:
+            self._stack.pop()
+
+    def add_span(self, name: str, start_s: float, duration_s: float,
+                 parent: Optional[int] = None, **attrs) -> int:
+        """Record an already-measured interval (e.g. the tokenize share a
+        coalesced worker measured and returned as a number) as a span."""
+        if parent is None and self._stack:
+            parent = self._stack[-1]
+        sp = Span(name, start_s, start_s + duration_s, parent=parent)
+        sp.attrs.update({k: float(v) for k, v in attrs.items()})
+        self.spans.append(sp)
+        return len(self.spans) - 1
+
+    # -- export ----------------------------------------------------------
+    def total_ms(self) -> float:
+        end = self.end_s if self.end_s is not None else time.monotonic()
+        return (end - self.t0) * 1e3
+
+    def to_dict(self) -> Dict:
+        children: Dict[Optional[int], List[int]] = {}
+        for i, sp in enumerate(self.spans):
+            children.setdefault(sp.parent, []).append(i)
+
+        def node(i: int) -> Dict:
+            sp = self.spans[i]
+            d = {
+                "name": sp.name,
+                "start_ms": round((sp.start_s - self.t0) * 1e3, 3),
+                "duration_ms": round(sp.duration_ms(), 3),
+            }
+            if sp.attrs:
+                d["attrs"] = {k: round(v, 3) for k, v in sp.attrs.items()}
+            kids = [node(j) for j in children.get(i, [])]
+            if kids:
+                d["spans"] = kids
+            return d
+
+        out = {
+            "trace_id": self.trace_id,
+            "started_at": self.started_at,
+            "total_ms": round(self.total_ms(), 3),
+            "spans": [node(i) for i in children.get(None, [])],
+        }
+        if self.attrs:
+            out["attrs"] = self.attrs
+        return out
+
+
+_current: "contextvars.ContextVar[Optional[Trace]]" = contextvars.ContextVar(
+    "rag_trace", default=None
+)
+
+
+def current_trace() -> Optional[Trace]:
+    return _current.get()
+
+
+def start_trace(trace_id: Optional[str] = None) -> Trace:
+    """Open a trace on this thread; pair with ``finish_trace``."""
+    tr = Trace(trace_id)
+    _current.set(tr)
+    return tr
+
+
+def finish_trace(tr: Trace, buffer: "Optional[TraceBuffer]" = None) -> Dict:
+    """Close the trace: close dangling spans, emit the structured JSON log,
+    push into the ring buffer, clear the contextvar. Returns the tree."""
+    now = time.monotonic()
+    tr.end_s = now
+    for idx in reversed(tr._stack):  # an exception can leave spans open
+        if tr.spans[idx].end_s is None:
+            tr.spans[idx].end_s = now
+    tr._stack.clear()
+    if _current.get() is tr:
+        _current.set(None)
+    tree = tr.to_dict()
+    if logger.isEnabledFor(logging.DEBUG):
+        logger.debug("%s", json.dumps(tree, separators=(",", ":")))
+    if buffer is not None:
+        buffer.add(tree)
+    return tree
+
+
+@contextmanager
+def span(name: str, **attrs):
+    """Record a stage span on the current trace (no-op cost when no trace
+    is active beyond the TraceAnnotation), and name the wrapped device work
+    on the xprof timeline either way."""
+    tr = _current.get()
+    idx = None
+    if tr is not None:
+        idx = tr.begin(name)
+        if attrs:
+            tr.spans[idx].attrs.update({k: float(v) for k, v in attrs.items()})
+    ann = _TraceAnnotation(name) if _TraceAnnotation is not None else None
+    if ann is not None:
+        ann.__enter__()
+    try:
+        yield tr.spans[idx] if idx is not None else None
+    finally:
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        if tr is not None and idx is not None:
+            tr.end(idx)
+
+
+class TraceBuffer:
+    """Fixed-capacity ring of finished trace trees (``/debug/traces``)."""
+
+    def __init__(self, capacity: int = 128):
+        self._lock = threading.Lock()
+        self._buf: "deque[Dict]" = deque(maxlen=capacity)
+
+    def add(self, tree: Dict) -> None:
+        with self._lock:
+            self._buf.append(tree)
+
+    def list(self, limit: Optional[int] = None) -> List[Dict]:
+        """Newest-last. ``limit`` trims to the newest N; non-positive
+        limits mean "no trim" (a negative slice would silently DROP the
+        oldest entry instead)."""
+        with self._lock:
+            items = list(self._buf)
+        return items[-limit:] if limit is not None and limit > 0 else items
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)

@@ -37,6 +37,8 @@ from rag_llm_k8s_tpu.core.config import AppConfig
 from rag_llm_k8s_tpu.engine.encoder import EncoderRunner
 from rag_llm_k8s_tpu.engine.engine import InferenceEngine
 from rag_llm_k8s_tpu.index.store import VectorStore
+from rag_llm_k8s_tpu.obs import metrics as obs_metrics
+from rag_llm_k8s_tpu.obs import tracing
 from rag_llm_k8s_tpu.rag.chunking import split_text
 from rag_llm_k8s_tpu.rag.pdf import extract_text
 from rag_llm_k8s_tpu.rag.prompt import assemble_context, assemble_prompt, extract_answer
@@ -45,23 +47,26 @@ from rag_llm_k8s_tpu.utils.tokens import truncate_keep_eos
 logger = logging.getLogger(__name__)
 
 
-class _Metrics:
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.counters: Dict[str, float] = {}
+def _package_version() -> str:
+    from rag_llm_k8s_tpu import __version__
 
-    def observe(self, name: str, value: float):
-        with self._lock:
-            self.counters[f"{name}_sum"] = self.counters.get(f"{name}_sum", 0.0) + value
-            self.counters[f"{name}_count"] = self.counters.get(f"{name}_count", 0) + 1
+    return __version__
 
-    def inc(self, name: str, value: float = 1):
-        with self._lock:
-            self.counters[name] = self.counters.get(name, 0) + value
 
-    def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            return dict(self.counters)
+def _engine_mode(scheduler) -> str:
+    """Serving mode for /healthz fleet segmentation: continuous (slot
+    engine) vs coalesce (group-at-start) vs one-shot (no scheduler)."""
+    if scheduler is None:
+        return "one-shot"
+    from rag_llm_k8s_tpu.engine.continuous import ContinuousScheduler
+
+    if isinstance(scheduler, ContinuousScheduler):
+        return "continuous"
+    from rag_llm_k8s_tpu.engine.batching import BatchScheduler
+
+    if isinstance(scheduler, BatchScheduler):
+        return "coalesce"
+    return type(scheduler).__name__
 
 
 def make_segment_source(llm_tokenizer, max_bucket: int):
@@ -106,7 +111,13 @@ class RagService:
         self.encoder_tokenizer = encoder_tokenizer
         self.store = store
         self.scheduler = scheduler
-        self.metrics = _Metrics()
+        # ONE registry per service: everything this service and its engines
+        # report lands in the same scrape (obs/metrics.py); the legacy
+        # facade keeps the seed's service.metrics API working unchanged
+        self.metrics = obs_metrics.MetricsRegistry()
+        self.traces = tracing.TraceBuffer(128)
+        self.started_at = time.monotonic()
+        self._init_observability()
         self.ready = False
         # per-stage in-flight counters, fed to the coalescers as
         # ``pending_hint``: each batching stage stops waiting out its window
@@ -140,6 +151,9 @@ class RagService:
                 max_batch=self._retrieve_cap, max_wait_ms=25.0,
                 pending_hint=lambda: self._inflight_retrieve,
             )
+            self.retrieve_coalescer.wait_histogram = (
+                self._m_coalesce_wait.labels(stage="retrieve")
+            )
             if getattr(scheduler, "pending_hint", False) is None:
                 # the generate scheduler is constructed by the caller; give
                 # it the same early-exit hint unless the caller set its own
@@ -162,6 +176,157 @@ class RagService:
                 llm_tokenizer, max(engine.engine_config.prompt_buckets)
             )
             store.attach_token_source(self._segment_source)
+
+    # -- observability ---------------------------------------------------
+    def _init_observability(self) -> None:
+        """Register this service's metric families and fold the engines'
+        live stats into the same registry (one scrape sees everything:
+        request/stage histograms, coalesce waits, TTFT/inter-token from the
+        engines, compile time, occupancy/queue gauges, index size)."""
+        reg = self.metrics
+        self._m_request = reg.histogram(
+            "rag_request_duration_seconds",
+            "end-to-end /generate duration, server side",
+            buckets=obs_metrics.REQUEST_BUCKETS,
+        )
+        self._m_stage = reg.labeled_histogram(
+            "rag_stage_duration_seconds",
+            "per-stage serving duration (stage label)",
+        )
+        for s in ("retrieve", "assemble", "prefix_resolve", "generate",
+                  "detokenize"):
+            self._m_stage.labels(stage=s)
+        self._m_coalesce_wait = reg.labeled_histogram(
+            "rag_coalesce_wait_seconds",
+            "enqueue-to-dispatch wait in the coalescing stages (stage label)",
+        )
+        for s in ("retrieve", "generate"):
+            self._m_coalesce_wait.labels(stage=s)
+        # present in every mode so dashboards stay uniform; only the
+        # continuous engine's host loop can actually observe it (exact
+        # submit→first-token), so it stays empty under coalesce serving
+        reg.histogram(
+            "rag_time_to_first_token_seconds",
+            "submit-to-first-token (queue + coalesce + prefill + fetch)",
+            buckets=obs_metrics.REQUEST_BUCKETS,
+        )
+        reg.gauge(
+            "rag_batch_occupancy",
+            "requests currently occupying the serving batch/slots",
+            fn=self._batch_occupancy,
+        )
+        reg.gauge(
+            "rag_admission_queue_depth",
+            "requests queued toward the generate scheduler",
+            fn=self._queue_depth,
+        )
+        # live engine stats as callback metrics: read at scrape time, zero
+        # writes on the engine hot path. BOTH serving engines sum (the
+        # scheduler's plus the one-shot engine serving over-bucket prompts
+        # through chunked prefill) — long-prompt requests stay visible.
+        reg.gauge("index_vectors",
+                  fn=lambda: self.store.ntotal if self.store is not None else 0)
+        reg.counter("engine_generate_calls",
+                    fn=lambda: self._engine_stat("generate_calls"))
+        reg.counter("engine_prefill_tokens",
+                    fn=lambda: self._engine_stat("prefill_tokens"))
+        reg.counter("engine_decode_tokens",
+                    fn=lambda: self._engine_stat("decode_tokens"))
+        # speculative decoding: emitted / verify_steps = measured acceptance
+        reg.counter("engine_spec_verify_steps",
+                    fn=lambda: self._engine_stat("spec_verify_steps"))
+        reg.counter("engine_spec_emitted_tokens",
+                    fn=lambda: self._engine_stat("spec_emitted_tokens"))
+        # KV prefix cache: prompt tokens whose prefill was skipped because
+        # their KV spliced from a cached block — computed (prefill_tokens)
+        # + skipped = logical prompt total
+        reg.counter("prefill_tokens_skipped",
+                    fn=lambda: self._engine_stat("prefill_tokens_skipped"))
+        reg.counter("prefix_cache_hits",
+                    fn=lambda: self._pcache_stat("prefix_cache_hits"))
+        reg.counter("prefix_cache_misses",
+                    fn=lambda: self._pcache_stat("prefix_cache_misses"))
+        reg.gauge("prefix_cache_entries",
+                  fn=lambda: self._pcache_stat("prefix_cache_entries"))
+        reg.gauge("prefix_cache_bytes",
+                  fn=lambda: self._pcache_stat("prefix_cache_bytes"))
+        for e in self._engines().values():
+            bind = getattr(e, "bind_metrics", None)
+            if bind is not None:
+                bind(reg)
+        if self.scheduler is not None and hasattr(self.scheduler, "wait_histogram"):
+            self.scheduler.wait_histogram = (
+                self._m_coalesce_wait.labels(stage="generate")
+            )
+
+    def _engines(self) -> Dict[int, object]:
+        """The serving engines, deduped by identity (see the summing note
+        in ``_init_observability``)."""
+        engines: Dict[int, object] = {}
+        if self.engine is not None:
+            engines[id(self.engine)] = self.engine
+        sched_engine = getattr(self.scheduler, "engine", None)
+        if sched_engine is not None:
+            engines[id(sched_engine)] = sched_engine
+        return engines
+
+    def _engine_stat(self, name: str) -> float:
+        return float(sum(
+            getattr(e.stats, name, 0) for e in self._engines().values()
+            if getattr(e, "stats", None) is not None
+        ))
+
+    def _pcache_stat(self, name: str) -> float:
+        total = 0.0
+        for e in self._engines().values():
+            pcache = getattr(e, "prefix_cache", None)
+            if pcache is not None:
+                total += pcache.counters().get(name, 0)
+        return total
+
+    def _batch_occupancy(self) -> float:
+        """Continuous mode: active device slots; coalescing mode: the size
+        of the batch currently inside engine.generate (BatchScheduler
+        tracks it at dispatch — NOT the answer()-entry claim, which would
+        count requests still in retrieve/assemble as batch pressure);
+        schedulerless serving falls back to the in-flight generate claim."""
+        sched = self.scheduler
+        slots = getattr(getattr(sched, "engine", None), "slots", None)
+        if slots is not None:
+            return float(sum(1 for s in slots if s.active))
+        in_flight = getattr(sched, "in_flight", None)
+        if in_flight is not None:
+            return float(in_flight)
+        return float(self._inflight_generate)
+
+    def _queue_depth(self) -> float:
+        q = getattr(self.scheduler, "_queue", None)
+        return float(q.qsize()) if q is not None else 0.0
+
+    def _observe_request(self, timings: Dict[str, float]) -> None:
+        """Feed the request/stage histograms from one answered query's
+        timings block (the same numbers the response carries) — called
+        EXACTLY ONCE per answered request, which is what keeps stage
+        counts equal to request counts. The assemble/detokenize stages
+        have no public timings key (the response contract is pinned), so
+        their span sites record private ``_*_s`` entries that are popped
+        and observed here: a fallback path that re-runs a stage just
+        overwrites the entry, never double-counts it."""
+        if "total_ms" in timings:
+            self._m_request.observe(timings["total_ms"] / 1e3)
+        stage_keys = {
+            "embed_retrieve_ms": "retrieve",
+            "prefix_resolve_ms": "prefix_resolve",
+            "generate_ms": "generate",
+        }
+        for key, stage in stage_keys.items():
+            if key in timings:
+                self._m_stage.labels(stage=stage).observe(timings[key] / 1e3)
+        for key, stage in (("_assemble_s", "assemble"),
+                           ("_detokenize_s", "detokenize")):
+            v = timings.pop(key, None)
+            if v is not None:
+                self._m_stage.labels(stage=stage).observe(v)
 
     # -- embedding ------------------------------------------------------
     def embed_texts(self, texts: List[str]) -> np.ndarray:
@@ -411,6 +576,26 @@ class RagService:
                     )
         return out
 
+    def _trace_retrieve(self, parent, t0: float, timings: Dict[str, float]) -> None:
+        """Attach the retrieve stage's interior to the live ``retrieve``
+        span: the device work ran on the coalescer worker (contextvars
+        don't cross threads), so the tokenize / fused-embed+kNN split is
+        synthesized from the SAME measurements the timings block carries
+        (the embed_knn child includes the coalesce wait — the per-request
+        wait distribution lives in ``rag_coalesce_wait_seconds``)."""
+        tr = tracing.current_trace()
+        if tr is None or parent is None:
+            return
+        # identity search: Span is a dataclass, so list.index would match
+        # by VALUE and could pick a different span with equal fields
+        pidx = next((i for i, s in enumerate(tr.spans) if s is parent), None)
+        if pidx is None:
+            return
+        tok_s = timings.get("tokenize_ms", 0.0) / 1e3
+        knn_s = timings.get("embed_retrieve_ms", 0.0) / 1e3
+        tr.add_span("tokenize", t0, tok_s, parent=pidx)
+        tr.add_span("embed_knn", t0 + tok_s, knn_s, parent=pidx)
+
     # -- query ----------------------------------------------------------
     def answer(self, user_prompt: str) -> Dict:
         timings: Dict[str, float] = {}
@@ -425,10 +610,14 @@ class RagService:
             # repurposing the old embed_ms/retrieve_ms split (which would
             # silently skew any cross-version comparison of stage timings)
             t0 = time.monotonic()
-            if self.retrieve_coalescer is not None:
-                r = self.retrieve_coalescer.submit(user_prompt)
-            else:
-                r = self._retrieve(user_prompt)
+            with tracing.span("retrieve") as retrieve_span:
+                # the wait side of the stage runs in THIS thread; the
+                # device work happens on the coalescer worker and its
+                # interior split re-attaches via _trace_retrieve below
+                if self.retrieve_coalescer is not None:
+                    r = self.retrieve_coalescer.submit(user_prompt)
+                else:
+                    r = self._retrieve(user_prompt)
             with self._inflight_lock:
                 self._inflight_retrieve -= 1
             in_retrieve = False
@@ -443,6 +632,7 @@ class RagService:
                 timings["embed_retrieve_ms"] = (
                     (time.monotonic() - t0) * 1e3 - tokenize_ms
                 )
+                self._trace_retrieve(retrieve_span, t0, timings)
                 # a fused request never reaches the scheduler: release the
                 # generate claim NOW or the scheduler's pending_hint would
                 # count this phantom for the whole multi-second generate,
@@ -471,6 +661,7 @@ class RagService:
                 timings["embed_retrieve_ms"] = (
                     (time.monotonic() - t0) * 1e3 - tokenize_ms
                 )
+                self._trace_retrieve(retrieve_span, t0, timings)
 
             if not results:
                 return {"generated_text": "No relevant information found in the index."}
@@ -497,34 +688,42 @@ class RagService:
                     self._inflight_generate += 1
                 in_generate = True
 
-            pw = (
-                self._piecewise_prompt(user_prompt, results)
-                if getattr(self.engine.engine_config, "rag_fused", False) else None
-            )
-            if pw is not None:
-                context, prompt_ids = pw
-            else:
-                context, prompt_ids = self._budgeted_prompt(user_prompt, results)
+            t_as = time.monotonic()
+            with tracing.span("assemble"):
+                pw = (
+                    self._piecewise_prompt(user_prompt, results)
+                    if getattr(self.engine.engine_config, "rag_fused", False) else None
+                )
+                if pw is not None:
+                    context, prompt_ids = pw
+                else:
+                    context, prompt_ids = self._budgeted_prompt(user_prompt, results)
+            timings["_assemble_s"] = time.monotonic() - t_as
 
             t0 = time.monotonic()
-            if self.scheduler is not None and len(prompt_ids) <= self._scheduler_prompt_cap():
-                out_ids = self.scheduler.submit(prompt_ids)
-            else:
-                # prompts beyond the scheduler's capability need chunked
-                # prefill, which fixed-length continuous slots cannot do — the
-                # one-shot engine runs them through the cache chunk by chunk
-                # instead of letting the scheduler truncate them. Release
-                # the generate claim first: this request never reaches the
-                # scheduler, so the pending_hint must not wait for it.
-                with self._inflight_lock:
-                    self._inflight_generate -= 1
-                in_generate = False
-                out_ids = self.engine.generate([prompt_ids])[0]
+            with tracing.span("generate"):
+                if self.scheduler is not None and len(prompt_ids) <= self._scheduler_prompt_cap():
+                    out_ids = self.scheduler.submit(prompt_ids)
+                else:
+                    # prompts beyond the scheduler's capability need chunked
+                    # prefill, which fixed-length continuous slots cannot do —
+                    # the one-shot engine runs them through the cache chunk by
+                    # chunk instead of letting the scheduler truncate them.
+                    # Release the generate claim first: this request never
+                    # reaches the scheduler, so the pending_hint must not
+                    # wait for it.
+                    with self._inflight_lock:
+                        self._inflight_generate -= 1
+                    in_generate = False
+                    out_ids = self.engine.generate([prompt_ids])[0]
             if in_generate:
                 with self._inflight_lock:
                     self._inflight_generate -= 1
                 in_generate = False
-            completion = self.llm_tokenizer.decode(out_ids)
+            t_de = time.monotonic()
+            with tracing.span("detokenize"):
+                completion = self.llm_tokenizer.decode(out_ids)
+            timings["_detokenize_s"] = time.monotonic() - t_de
             timings["generate_ms"] = (time.monotonic() - t0) * 1e3
             timings["total_ms"] = (time.monotonic() - t_all) * 1e3
         finally:
@@ -538,6 +737,7 @@ class RagService:
 
         self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
         self.metrics.inc("query_decode_tokens", len(out_ids))
+        self._observe_request(timings)
         return {
             "generated_text": extract_answer(completion),
             "context": context,
@@ -592,29 +792,37 @@ class RagService:
         cache = getattr(self.engine, "prefix_cache", None)
         if cache is None:
             return None
-        ps = self._prompt_segments(user_prompt, results)
+        t_as = time.monotonic()
+        with tracing.span("assemble"):
+            ps = self._prompt_segments(user_prompt, results)
+        timings["_assemble_s"] = time.monotonic() - t_as
         if ps is None:
             return None
         context, segments, b_ids = ps
         if not b_ids:
             return None
         t_r = time.monotonic()
-        try:
-            cp = cache.prefix_for(segments)
-        except Exception:  # noqa: BLE001 — cache trouble must not 500 the query
-            logger.exception("prefix-cache resolve failed; host fallback")
-            return None
+        with tracing.span("prefix_resolve"):
+            try:
+                cp = cache.prefix_for(segments)
+            except Exception:  # noqa: BLE001 — cache trouble must not 500 the query
+                logger.exception("prefix-cache resolve failed; host fallback")
+                return None
         if cp is None:
             return None
         # hit: a dict lookup (~0); miss: the segment-build prefill — keep it
         # out of generate_ms so the stage split stays honest either way
         timings["prefix_resolve_ms"] = (time.monotonic() - t_r) * 1e3
         t0 = time.monotonic()
-        try:
-            out_ids = self.engine.generate_prefixed(b_ids, cp)
-        except ValueError:
-            return None  # tail over the suffix ladder: cold path serves
-        completion = self.llm_tokenizer.decode(out_ids)
+        with tracing.span("generate"):
+            try:
+                out_ids = self.engine.generate_prefixed(b_ids, cp)
+            except ValueError:
+                return None  # tail over the suffix ladder: cold path serves
+        t_de = time.monotonic()
+        with tracing.span("detokenize"):
+            completion = self.llm_tokenizer.decode(out_ids)
+        timings["_detokenize_s"] = time.monotonic() - t_de
         timings["generate_ms"] = (time.monotonic() - t0) * 1e3
         total_prompt = cp.length + len(b_ids)
         timings["prefix_reuse_frac"] = cp.reused_tokens / max(total_prompt, 1)
@@ -623,6 +831,7 @@ class RagService:
         self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
         self.metrics.inc("query_decode_tokens", len(out_ids))
         self.metrics.inc("query_prefix_cached", 1)
+        self._observe_request(timings)
         return {
             "generated_text": extract_answer(completion),
             "context": context,
@@ -681,10 +890,14 @@ class RagService:
         th = threading.Thread(target=_fetch_ids, daemon=True, name="ids-fetch")
         th.start()
         t0 = time.monotonic()
-        out_ids = self.engine.generate_rag(
-            a_ids, b_ids, packed_dev, toks_dev, lens_dev, n_chunks=n_ctx
-        )
-        completion = self.llm_tokenizer.decode(out_ids)
+        with tracing.span("generate"):
+            out_ids = self.engine.generate_rag(
+                a_ids, b_ids, packed_dev, toks_dev, lens_dev, n_chunks=n_ctx
+            )
+        t_de = time.monotonic()
+        with tracing.span("detokenize"):
+            completion = self.llm_tokenizer.decode(out_ids)
+        timings["_detokenize_s"] = time.monotonic() - t_de
         timings["generate_ms"] = (time.monotonic() - t0) * 1e3
         th.join(timeout=120)
         if "packed" not in box:
@@ -711,6 +924,7 @@ class RagService:
         self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
         self.metrics.inc("query_decode_tokens", len(out_ids))
         self.metrics.inc("query_single_fetch", 1)
+        self._observe_request(timings)
         return {
             "generated_text": extract_answer(completion),
             "context": context,
@@ -993,8 +1207,12 @@ class WsgiApp:
                 Rule("/healthz", endpoint="healthz", methods=["GET"]),
                 Rule("/metrics", endpoint="metrics", methods=["GET"]),
                 Rule("/profile", endpoint="profile", methods=["POST"]),
+                Rule("/debug/traces", endpoint="debug_traces", methods=["GET"]),
             ]
         )
+        # background xprof capture state (/profile {"seconds": N})
+        self._profile_lock = threading.Lock()
+        self._profile_until: Optional[float] = None
 
     # -- helpers --------------------------------------------------------
     def _jsonify(self, payload, status: int = 200):
@@ -1021,14 +1239,29 @@ class WsgiApp:
         return self._jsonify({"error": "Invalid file format"}, 400)
 
     def ep_generate(self, request):
+        tr = None
         try:
             data = request.get_json(force=True, silent=True) or {}
             user_prompt = data.get("prompt", "")
             logger.debug("User query: %s", user_prompt)
-            return self._jsonify(self.service.answer(user_prompt))
+            # every request is traced into the ring buffer (/debug/traces);
+            # {"trace": true} additionally returns the span tree inline
+            tr = tracing.start_trace()
+            tr.attrs["prompt"] = user_prompt[:80]
+            resp = self.service.answer(user_prompt)
+            tree = tracing.finish_trace(tr, self.service.traces)
+            tr = None
+            if data.get("trace"):
+                resp = dict(resp)
+                resp["trace"] = tree
+            return self._jsonify(resp)
         except Exception as e:  # noqa: BLE001 — parity with rag.py:179-181
             logger.exception("generate failed")
             return self._jsonify({"error": str(e)}, 500)
+        finally:
+            if tr is not None:  # error path: keep the partial trace visible
+                tr.attrs["error"] = True
+                tracing.finish_trace(tr, self.service.traces)
 
     def ep_index_info(self, request):
         try:
@@ -1037,93 +1270,124 @@ class WsgiApp:
             return self._jsonify({"error": str(e)}, 500)
 
     def ep_healthz(self, request):
-        ready = self.service.ready
-        return self._jsonify({"status": "ok" if ready else "warming"}, 200 if ready else 503)
+        svc = self.service
+        ready = svc.ready
+        body = {
+            "status": "ok" if ready else "warming",
+            # fleet-dashboard segmentation fields (ISSUE 2 satellite)
+            "uptime_s": round(time.monotonic() - svc.started_at, 1),
+            "version": _package_version(),
+            "engine_mode": _engine_mode(svc.scheduler),
+        }
+        try:
+            import jax
+
+            devices = jax.devices()
+            body["device_platform"] = devices[0].platform if devices else "none"
+            body["device_count"] = len(devices)
+        except Exception:  # noqa: BLE001 — health must answer even off-JAX
+            body["device_platform"] = "unknown"
+            body["device_count"] = 0
+        return self._jsonify(body, 200 if ready else 503)
 
     def ep_metrics(self, request):
-        snap = self.service.metrics.snapshot()
-        # BOTH serving engines count: the scheduler's handles in-bucket
-        # traffic, while over-bucket prompts run through the one-shot
-        # engine's chunked prefill — summing keeps long-prompt requests
-        # visible instead of vanishing from the counters
-        svc = self.service
-        engines = {id(svc.engine): svc.engine}
-        if svc.scheduler is not None:
-            engines[id(svc.scheduler.engine)] = svc.scheduler.engine
-        from rag_llm_k8s_tpu.engine.engine import EngineStats
-
-        stats = EngineStats(
-            prefill_tokens=sum(e.stats.prefill_tokens for e in engines.values()),
-            decode_tokens=sum(e.stats.decode_tokens for e in engines.values()),
-            generate_calls=sum(e.stats.generate_calls for e in engines.values()),
-            spec_verify_steps=sum(
-                getattr(e.stats, "spec_verify_steps", 0) for e in engines.values()
-            ),
-            spec_emitted_tokens=sum(
-                getattr(e.stats, "spec_emitted_tokens", 0) for e in engines.values()
-            ),
-            prefill_tokens_skipped=sum(
-                getattr(e.stats, "prefill_tokens_skipped", 0)
-                for e in engines.values()
-            ),
-        )
-        snap.update(
-            {
-                "engine_generate_calls": stats.generate_calls,
-                "engine_prefill_tokens": stats.prefill_tokens,
-                "engine_decode_tokens": stats.decode_tokens,
-                # speculative decoding: spec_emitted_tokens /
-                # spec_verify_steps = measured acceptance (tokens/verify)
-                "engine_spec_verify_steps": stats.spec_verify_steps,
-                "engine_spec_emitted_tokens": stats.spec_emitted_tokens,
-                # KV prefix cache: prompt tokens whose prefill was skipped
-                # because their KV spliced from a cached block — computed
-                # (engine_prefill_tokens) + skipped = logical prompt total
-                "prefill_tokens_skipped": stats.prefill_tokens_skipped,
-                "index_vectors": self.service.store.ntotal,
-            }
-        )
-        for e in engines.values():
-            pcache = getattr(e, "prefix_cache", None)
-            if pcache is not None:
-                for key, val in pcache.counters().items():
-                    if key == "prefill_tokens_skipped":
-                        continue  # the engine-stat sum above already has it
-                    snap[key] = snap.get(key, 0) + val
-        # Prometheus text exposition by default so a scraper can actually
-        # consume this (survey §5); the JSON shape stays available under
-        # Accept: application/json for humans and the existing tests
+        """One scrape sees everything (obs/metrics.py): the request/stage/
+        TTFT/inter-token histograms, coalesce waits, compile counters,
+        occupancy/queue gauges, engine stats and prefix-cache state — all
+        families live in the service's registry, engine stats as callback
+        metrics read at scrape time. Prometheus text exposition by default;
+        the flat JSON snapshot stays available under Accept:
+        application/json (same values — tests/test_obs.py pins it)."""
+        reg = self.service.metrics
         if "application/json" in (request.headers.get("Accept") or ""):
-            return self._jsonify(snap)
-        import re as _re
-
-        lines = []
-        # everything _Metrics records is monotonic (inc/observe only ever
-        # add); the level-valued samples are the live index size and the
-        # prefix cache's current occupancy
-        gauges = {"index_vectors", "prefix_cache_entries", "prefix_cache_bytes"}
-        for key in sorted(snap):
-            name = "tpu_rag_" + _re.sub(r"[^a-zA-Z0-9_]", "_", str(key))
-            kind = "gauge" if key in gauges else "counter"
-            lines.append(f"# TYPE {name} {kind}")
-            lines.append(f"{name} {float(snap[key])!r}")
-        body = "\n".join(lines) + "\n"
+            return self._jsonify(reg.snapshot())
         return self._Response(
-            body, status=200, content_type="text/plain; version=0.0.4; charset=utf-8"
+            reg.render_prometheus(), status=200,
+            content_type="text/plain; version=0.0.4; charset=utf-8",
         )
+
+    def ep_debug_traces(self, request):
+        """Recent request span trees from the in-memory ring buffer."""
+        try:
+            limit = request.args.get("limit", type=int)
+            return self._jsonify({"traces": self.service.traces.list(limit)})
+        except Exception as e:  # noqa: BLE001
+            return self._jsonify({"error": str(e)}, 500)
 
     def ep_profile(self, request):
-        """Capture a jax.profiler device trace around one sample query
-        (tracing/profiling subsystem — absent from the reference, survey §5).
-        Body: {"prompt": str?, "dir": str?, "seconds": float?}."""
+        """Capture a jax.profiler device trace (xprof).
+
+        Two modes (body keys):
+        - ``{"seconds": N, "dir": str?}`` — NON-BLOCKING: starts a
+          background capture window around live traffic and returns
+          immediately; a timer thread stops the trace after N seconds.
+          409 while a window is already open.
+        - ``{"prompt": str?, "dir": str?}`` — legacy blocking mode: traces
+          one sample query inside the handler.
+        """
         try:
             import jax
 
             data = request.get_json(force=True, silent=True) or {}
             trace_dir = data.get("dir", "/tmp/tpu_rag_trace")
-            prompt = data.get("prompt", "What is this document about?")
-            with jax.profiler.trace(trace_dir):
-                result = self.service.answer(prompt)
+
+            def _busy_response():
+                until = self._profile_until
+                return self._jsonify(
+                    {
+                        "error": "a profile capture is already running",
+                        # None for a blocking capture (end time unknown)
+                        "until": until if until != float("inf") else None,
+                    },
+                    409,
+                )
+
+            if "seconds" in data:
+                seconds = float(data["seconds"])
+                if not 0 < seconds <= 300:
+                    return self._jsonify(
+                        {"error": "seconds must be in (0, 300]"}, 400
+                    )
+                with self._profile_lock:
+                    if self._profile_until is not None:
+                        return _busy_response()
+                    jax.profiler.start_trace(trace_dir)
+                    self._profile_until = time.time() + seconds
+
+                def _stop():
+                    try:
+                        jax.profiler.stop_trace()
+                    except Exception:  # noqa: BLE001 — stop must not kill the timer
+                        logger.exception("profile stop failed")
+                    finally:
+                        with self._profile_lock:
+                            self._profile_until = None
+
+                t = threading.Timer(seconds, _stop)
+                t.daemon = True
+                t.start()
+                return self._jsonify(
+                    {
+                        "trace_dir": trace_dir,
+                        "seconds": seconds,
+                        "message": "background capture started around live "
+                        "traffic; open with tensorboard or xprof",
+                    }
+                )
+            # legacy blocking mode shares the SAME single-capture guard:
+            # jax.profiler allows only one active trace, so racing a window
+            # capture would otherwise surface as a confusing 500
+            with self._profile_lock:
+                if self._profile_until is not None:
+                    return _busy_response()
+                self._profile_until = float("inf")  # blocking: end unknown
+            try:
+                prompt = data.get("prompt", "What is this document about?")
+                with jax.profiler.trace(trace_dir):
+                    result = self.service.answer(prompt)
+            finally:
+                with self._profile_lock:
+                    self._profile_until = None
             return self._jsonify(
                 {
                     "trace_dir": trace_dir,

@@ -181,6 +181,11 @@ def main():
     app = create_app(service)
     cfg = service.config.server
     logger.info("serving on %s:%d", cfg.host, cfg.port)
+    logger.info(
+        "observability: /metrics (Prometheus exposition), /debug/traces "
+        "(span-tree ring), /profile {\"seconds\": N} (background xprof) — "
+        "see docs/OBSERVABILITY.md"
+    )
     app.run(host=cfg.host, port=cfg.port)
 
 
