@@ -34,6 +34,19 @@ tpu-test:
 bench:
 	python bench.py
 
+# Perf regression gate (scripts/bench_gate.py): compare a fresh bench JSON
+# against a committed baseline with per-metric tolerance bands, direction
+# aware (latency up = bad, tok/s down = bad). Defaults to comparing the
+# baseline against itself (a self-comparison smoke that must pass); for a
+# real judgment use a round artifact as the baseline (its {"parsed": ...}
+# envelope is unwrapped) and a fresh capture as current:
+#   make bench-gate BENCH_BASELINE=BENCH_r03.json BENCH_CURRENT=/tmp/bench_fresh.json
+# Disjoint schemas (zero shared comparable metrics) exit 2, never "OK".
+BENCH_BASELINE ?= BENCH_BASELINE.json
+BENCH_CURRENT ?= $(BENCH_BASELINE)
+bench-gate:
+	python scripts/bench_gate.py --baseline $(BENCH_BASELINE) --current $(BENCH_CURRENT)
+
 # Static checks: ruff (when the environment provides it — this container
 # does not bake it in, and the no-new-deps rule forbids installing it here)
 # plus the metrics↔docs consistency gate: every metric name registered in
@@ -62,4 +75,11 @@ check: test tpu-test bench
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8); print('dryrun_multichip(8): OK')"
 
-.PHONY: test tier1 tpu-test bench lint check validate-8b validate-70b
+# The no-hardware CI lane: the tier-1 gate verbatim, static checks, and a
+# fast bench-gate schema pass (validates the baseline + gate plumbing
+# without running the bench — the TPU-judged comparison is `make bench`
+# followed by `make bench-gate BENCH_CURRENT=...`).
+ci: tier1 lint
+	python scripts/bench_gate.py --baseline $(BENCH_BASELINE) --dry-run
+
+.PHONY: test tier1 tpu-test bench bench-gate ci lint check validate-8b validate-70b

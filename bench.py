@@ -50,6 +50,7 @@ import io
 import json
 import math
 import os
+import signal
 import time
 import zlib
 
@@ -1748,38 +1749,114 @@ def get_cpu_baseline() -> float:
     return tps
 
 
+class BenchBudgetExceeded(BaseException):
+    """Raised in the main thread by the budget guard (SIGTERM/SIGALRM).
+
+    BaseException on purpose — the legs' own ``except Exception`` error
+    handling must never swallow the budget signal (the same reasoning as
+    KeyboardInterrupt)."""
+
+
+def install_budget_guard():
+    """SIGTERM/SIGALRM → BenchBudgetExceeded, so a driver timeout (the
+    ``timeout -k 10 900`` wrapper that produced BENCH_r05's ``rc: 124,
+    parsed: null`` data loss) lands as a catchable exception BETWEEN
+    bytecodes instead of killing the process mid-leg with nothing printed.
+    ``TPU_RAG_BENCH_BUDGET_S`` additionally arms an internal alarm — set it
+    a little under the external timeout so the partial JSON always wins the
+    race against SIGKILL. No-op (returns None) off the main thread."""
+
+    def _raise(signum, frame):
+        raise BenchBudgetExceeded(signal.Signals(signum).name)
+
+    try:
+        signal.signal(signal.SIGTERM, _raise)
+        signal.signal(signal.SIGALRM, _raise)
+    except ValueError:  # not the main thread (bench imported as a library)
+        return None
+    budget = os.environ.get("TPU_RAG_BENCH_BUDGET_S")
+    if budget:
+        try:
+            signal.alarm(max(1, int(float(budget))))
+        except ValueError:
+            return None
+    return budget
+
+
+def bench_legs(line: dict):
+    """The measurement legs in run order as ``(name, thunk)`` — each thunk
+    folds its fields into ``line`` when it completes, so the document is
+    valid after ANY prefix of legs (the budget guard's partial-emit
+    contract; tests/test_slo.py pins the truncation shape)."""
+    state = {}
+
+    def leg_cpu_baseline():
+        state["baseline"] = get_cpu_baseline()
+
+    def leg_decode():
+        tpu = measure_tpu()
+        line.update(
+            {
+                "value": round(tpu["tok_per_s"], 1),
+                "decode_batch": BATCH,
+                # headline serving config: bf16 weights + int8 KV — the
+                # largest configuration whose FULL-budget cache fits HBM
+                # (docs/DECODE_PERF.md)
+                "decode_kv_quant": HEADLINE_KV,
+                "decode_bf16_sweep": {str(b): v for b, v in tpu["sweep"].items()},
+                "decode_int8_tok_per_s": {str(b): v for b, v in tpu["int8"].items()},
+            }
+        )
+        if "baseline" in state:
+            line["vs_baseline"] = round(tpu["tok_per_s"] / state["baseline"], 1)
+
+    return [
+        ("cpu_baseline", leg_cpu_baseline),
+        ("decode", leg_decode),
+        ("prefill", lambda: line.update(measure_prefill())),
+        ("8b_int8", lambda: line.update(measure_8b_int8())),
+        ("longctx", lambda: line.update(measure_longctx())),
+        ("knn_scale", lambda: line.update(measure_knn_scale())),
+        ("speculative", lambda: line.update(measure_speculative())),
+        ("continuous", lambda: line.update(measure_continuous())),
+        ("query_e2e", lambda: line.update(measure_query_e2e())),
+        ("ingest_scale", lambda: line.update(measure_ingest_scale())),
+    ]
+
+
 def main():
-    baseline = get_cpu_baseline()
-    tpu = measure_tpu()
-    pf = measure_prefill()
-    b8 = measure_8b_int8()
-    lc = measure_longctx()
-    knn = measure_knn_scale()
-    spec = measure_speculative()
-    cont = measure_continuous()
-    e2e = measure_query_e2e()
-    ing = measure_ingest_scale()
+    install_budget_guard()
     line = {
         "metric": "llama_1b_decode_throughput",
-        "value": round(tpu["tok_per_s"], 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(tpu["tok_per_s"] / baseline, 1),
-        "decode_batch": BATCH,
-        # headline serving config: bf16 weights + int8 KV — the largest
-        # configuration whose FULL-budget cache fits HBM (docs/DECODE_PERF.md)
-        "decode_kv_quant": HEADLINE_KV,
-        "decode_bf16_sweep": {str(b): v for b, v in tpu["sweep"].items()},
-        "decode_int8_tok_per_s": {str(b): v for b, v in tpu["int8"].items()},
         "query_p50_target_ms": 2000,  # BASELINE.md north star: p50 < 2 s
     }
-    line.update(pf)
-    line.update(b8)
-    line.update(lc)
-    line.update(knn)
-    line.update(spec)
-    line.update(cont)
-    line.update(e2e)
-    line.update(ing)
+    legs = []
+    completed = []
+    truncated_by = None
+    # ONE try covers everything from here to disarm: a signal landing in
+    # the loop bookkeeping (not just inside a leg) must still reach the
+    # partial-emit path, or the rc-124/parsed-null data loss comes back
+    try:
+        legs = bench_legs(line)
+        for name, thunk in legs:
+            thunk()
+            completed.append(name)
+    except BenchBudgetExceeded as e:
+        truncated_by = str(e) or "signal"
+    # disarm UNCONDITIONALLY before the final print: a TERM arriving after
+    # the last leg (or timeout's repeat TERM) must not kill the JSON emit
+    try:
+        signal.alarm(0)
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGALRM, signal.SIG_IGN)
+    except ValueError:
+        pass  # not the main thread: the guard never armed
+    if truncated_by is not None:
+        line["truncated"] = True
+        line["truncated_by"] = truncated_by
+        line["legs_completed"] = completed
+        line["legs_skipped"] = [n for n, _ in legs if n not in completed]
     print(json.dumps(line))
 
 

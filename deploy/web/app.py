@@ -1,21 +1,37 @@
 """Streamlit web UI — behavior parity with /root/reference/web/app.py: a text
 box + Generate button POSTing to the LLM service, rendering generated_text.
 Additions: renders the retrieval context and per-stage timings the TPU server
-returns (the reference drops the 'context' field — web/app.py:15-19)."""
+returns (the reference drops the 'context' field — web/app.py:15-19), and
+ORIGINATES a W3C ``traceparent`` header per click so one trace id follows the
+request web → server → span tree → structured logs (the server echoes it in
+``x-trace-id``; paste it into ``GET /debug/traces`` or the log search)."""
 
 import os
+import uuid
 
 import requests
 import streamlit as st
 
 LLM_SERVICE_URL = os.environ.get("LLM_SERVICE_URL", "http://llm-service:80")
 
+
+def new_traceparent() -> str:
+    """W3C trace-context: 00-<32hex trace>-<16hex span>-01. Self-contained
+    (the web pod does not install the server package)."""
+    return f"00-{uuid.uuid4().hex}-{uuid.uuid4().hex[:16]}-01"
+
+
 st.title("RAG LLM (TPU)")
 
 prompt = st.text_input("Enter your prompt:")
 if st.button("Generate") and prompt:
+    traceparent = new_traceparent()
     with st.spinner("Generating..."):
-        resp = requests.post(f"{LLM_SERVICE_URL}/generate", json={"prompt": prompt})
+        resp = requests.post(
+            f"{LLM_SERVICE_URL}/generate",
+            json={"prompt": prompt},
+            headers={"traceparent": traceparent},
+        )
     if resp.status_code == 200:
         body = resp.json()
         st.write(body.get("generated_text", ""))
@@ -24,9 +40,14 @@ if st.button("Generate") and prompt:
             st.caption(
                 " | ".join(f"{k}: {v} ms" for k, v in timings.items())
             )
+        trace_id = resp.headers.get("x-trace-id")
+        if trace_id:
+            st.caption(f"trace: {trace_id}")
         context = body.get("context")
         if context:
             with st.expander("Retrieved context"):
                 st.text(context)
     else:
-        st.error(f"Error {resp.status_code}: {resp.text}")
+        trace_id = resp.headers.get("x-trace-id", "")
+        suffix = f" (trace {trace_id})" if trace_id else ""
+        st.error(f"Error {resp.status_code}: {resp.text}{suffix}")

@@ -174,6 +174,22 @@ class ContinuousEngine:
             "duration over decode steps; continuous is exact per window)",
             buckets=obs_metrics.TOKEN_LATENCY_BUCKETS,
         ).labels(mode="continuous")
+        # step-time breakdown (ISSUE 3 per-device telemetry): where one sync
+        # window's wall clock goes — the device step + token-plane fetch
+        # (phase=device_fetch), host retire bookkeeping (phase=host_drain),
+        # and admission work between windows (phase=admit: prefill + insert
+        # + first-token fetch for a whole admitted chunk). On a dashboard, a
+        # growing device_fetch share under flat host_drain is link pressure;
+        # a growing admit share is churn (short answers re-admitting).
+        step_fam = registry.labeled_histogram(
+            "rag_continuous_step_seconds",
+            "continuous-engine step-time breakdown (phase label: "
+            "device_fetch | host_drain | admit)",
+            buckets=obs_metrics.LATENCY_BUCKETS,
+        )
+        self._m_step_device = step_fam.labels(phase="device_fetch")
+        self._m_step_drain = step_fam.labels(phase="host_drain")
+        self._m_step_admit = step_fam.labels(phase="admit")
 
     def warmup(self, batch_sizes=None, buckets=None):
         """AOT-compile every executable serving will hit (readiness gating).
@@ -729,6 +745,7 @@ class ContinuousEngine:
 
     def _admit_chunk(self, S: int, chunk, rows: List[int], results: List):
         """One batched prefill + insert + first-token fetch for ``chunk``."""
+        t_admit = time.perf_counter()
         n = len(chunk)
         tokens = np.full((n, S), self.pad_id, np.int32)
         mask = np.zeros((n, S), np.int32)
@@ -768,6 +785,7 @@ class ContinuousEngine:
 
         try:
             tok0_h = np.asarray(tok0s)  # ONE fetch for the whole chunk
+            self._m_step_admit.observe(time.perf_counter() - t_admit)
             deactivate = []
             for r, (i, rid, _, p, max_new_c, _) in enumerate(chunk):
                 tok0 = int(tok0_h[r])
@@ -821,7 +839,9 @@ class ContinuousEngine:
         # EXACT inter-token latency: one sync window (device step + the
         # token-plane fetch) amortized over its k steps — every active row
         # advanced k tokens in this wall-clock interval
-        self._m_itl.observe((time.perf_counter() - t0) / k)
+        t_fetch = time.perf_counter()
+        self._m_itl.observe((t_fetch - t0) / k)
+        self._m_step_device.observe(t_fetch - t0)
         eos_h = np.asarray(eoss)
         done: List[Tuple[int, List[int]]] = []
         deactivate = []
@@ -849,6 +869,7 @@ class ContinuousEngine:
             mask = np.ones(self.B, bool)
             mask[deactivate] = False
             self._active = self._active & self._put(jnp.asarray(mask))
+        self._m_step_drain.observe(time.perf_counter() - t_fetch)
         return done
 
 

@@ -132,6 +132,39 @@ class PrefixCache:
                 "prefix_cache_bytes": self.entry_bytes + self.assembled_bytes,
             }
 
+    def bytes_by_device(self) -> Dict[int, int]:
+        """Resident cache bytes attributed per device id (segment blocks +
+        assembled buffers) — the per-device scrape view
+        (``rag_prefix_cache_device_bytes``, obs/devices.py). A plane sharded
+        over several devices splits its bytes evenly across them; planes
+        without a ``devices()`` API (CPU test doubles) attribute to device
+        0. Reads only host-side handles — no device sync."""
+        out: Dict[int, int] = {}
+
+        def _attribute(planes: Tuple) -> None:
+            for p in planes:
+                nbytes = int(getattr(p, "nbytes", 0))
+                try:
+                    devs = list(p.devices())
+                except Exception:  # noqa: BLE001 — non-jax arrays: device 0
+                    devs = []
+                if not devs:
+                    out[0] = out.get(0, 0) + nbytes
+                    continue
+                share = nbytes // len(devs)
+                for d in devs:
+                    did = int(getattr(d, "id", 0))
+                    out[did] = out.get(did, 0) + share
+
+        with self._lock:
+            entries = [e.planes for e in self._entries.values()]
+            buffers = [buf for buf, _ in self._assembled.values()]
+        for planes in entries:
+            _attribute(planes)
+        for planes in buffers:
+            _attribute(planes)
+        return out
+
     # -- the one public resolve/populate entry point ---------------------
     def prefix_for(self, segments: Sequence[Tuple[str, Sequence[int]]]
                    ) -> Optional[CachedPrefix]:

@@ -13,6 +13,17 @@ Three pillars (ISSUE 2):
 - engine instrumentation (TTFT / inter-token / occupancy / compile time)
   lives at the call sites in ``engine/`` and ``server/`` and reports into
   the registry.
+
+The decision layer on top (ISSUE 3):
+
+- ``obs.slo`` — declarative SLOs evaluated over sliding windows of the
+  registry's histograms/counters, multi-window burn-rate alerting
+  (``GET /slo`` + ``rag_slo_*`` gauges);
+- ``obs.logging`` — W3C ``traceparent`` parse/emit and trace-correlated
+  structured JSON logs;
+- ``obs.devices`` — per-device HBM / prefix-cache residency gauges;
+- ``obs.regression`` — the direction-aware bench regression comparator
+  behind ``make bench-gate``.
 """
 
 from rag_llm_k8s_tpu.obs.metrics import MetricsRegistry, default_registry  # noqa: F401

@@ -1,0 +1,89 @@
+"""Per-device telemetry: HBM occupancy + prefix-cache residency gauges.
+
+The prefix cache (PR 1) runs an HBM-budgeted LRU, and the continuous engine
+parks multi-GB KV state per chip — but until now the scrape had no
+per-device view, so an HBM-pressure eviction storm looked like generic
+latency noise. These gauges label every family by device index:
+
+- ``rag_device_hbm_bytes_in_use`` / ``rag_device_hbm_bytes_limit`` — read
+  from ``device.memory_stats()`` at collect time (the live allocator view,
+  zero writes on any hot path). CPU devices (and backends without the API)
+  report **zero gracefully** — tier-1 runs on ``JAX_PLATFORMS=cpu`` and a
+  scrape there must stay boring, not crash;
+- ``rag_prefix_cache_device_bytes`` — the cache's resident KV attributed to
+  the device(s) actually holding the planes (sharded planes split their
+  bytes evenly across their device set), via
+  :meth:`~rag_llm_k8s_tpu.engine.prefix_cache.PrefixCache.bytes_by_device`.
+
+Registration is idempotent per registry (callback children just swap their
+probe), and services that never enable the prefix cache still export the
+family at zero so dashboards stay uniform across the fleet.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from rag_llm_k8s_tpu.obs import metrics as obs_metrics
+
+__all__ = ["register_device_gauges", "local_devices"]
+
+
+def local_devices() -> List:
+    """``jax.local_devices()`` or [] when jax is absent/unusable — device
+    telemetry must never be the thing that breaks an import."""
+    try:
+        import jax
+
+        return list(jax.local_devices())
+    except Exception:  # noqa: BLE001 — no jax, no devices, no gauges
+        return []
+
+
+def _memory_stat(device, key: str) -> float:
+    """One allocator stat, 0.0 when unavailable (CPU backends return None
+    or raise — the graceful-zero contract)."""
+    if getattr(device, "platform", "") == "cpu":
+        return 0.0
+    try:
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — a probe must not 500 /metrics
+        return 0.0
+    if not stats:
+        return 0.0
+    return float(stats.get(key, 0.0))
+
+
+def register_device_gauges(
+    registry: obs_metrics.MetricsRegistry,
+    prefix_bytes_fn: Optional[Callable[[], Dict[int, int]]] = None,
+) -> int:
+    """Register the per-device families on ``registry``; returns the device
+    count. ``prefix_bytes_fn`` returns ``{device_id: bytes}`` for the
+    prefix cache (None/empty → zeros, keeping the family present)."""
+    devices = local_devices()
+    use_fam = registry.labeled_gauge(
+        "rag_device_hbm_bytes_in_use",
+        "allocator bytes in use per device (0 on CPU/backends without "
+        "memory_stats)",
+    )
+    lim_fam = registry.labeled_gauge(
+        "rag_device_hbm_bytes_limit", "allocator byte limit per device"
+    )
+    pc_fam = registry.labeled_gauge(
+        "rag_prefix_cache_device_bytes",
+        "KV prefix-cache bytes resident per device",
+    )
+    fn = prefix_bytes_fn or (lambda: {})
+    for d in devices:
+        did = int(getattr(d, "id", 0))
+        use_fam.labels_callback(
+            lambda d=d: _memory_stat(d, "bytes_in_use"), device=str(did)
+        )
+        lim_fam.labels_callback(
+            lambda d=d: _memory_stat(d, "bytes_limit"), device=str(did)
+        )
+        pc_fam.labels_callback(
+            lambda did=did, fn=fn: float(fn().get(did, 0)), device=str(did)
+        )
+    return len(devices)

@@ -274,6 +274,17 @@ class _Family:
                 self._children[key] = child
         return child
 
+    def labels_callback(self, fn: Callable[[], float], **labelvalues: str):
+        """A per-label-set CALLBACK child: unlike the family-wide ``fn=``
+        (shared via child_kw), each label set reads its own probe at collect
+        time — how per-device gauges and the SLO burn gauges fold live state
+        into one labeled family. Idempotent: re-registering swaps the probe."""
+        if self.kind == "histogram":
+            raise ValueError("histograms cannot be callback-valued")
+        child = self.labels(**labelvalues)
+        child._fn = fn
+        return child
+
     def items(self):
         with self._lock:
             return list(self._children.items())
@@ -324,6 +335,16 @@ class MetricsRegistry:
 
     def labeled_counter(self, name: str, help: str = "") -> _Family:
         return self._family(name, "counter", help)
+
+    def labeled_gauge(self, name: str, help: str = "") -> _Family:
+        return self._family(name, "gauge", help)
+
+    def get_family(self, name: str) -> Optional[_Family]:
+        """The registered family (or None) — read-side consumers (the SLO
+        engine windows over the request histograms) find their sources here
+        without creating empty families as a side effect."""
+        with self._lock:
+            return self._families.get(name)
 
     # -- legacy facade (the seed's _Metrics API) ------------------------
     def observe(self, name: str, value: float) -> None:

@@ -66,8 +66,16 @@ class Trace:
     that run on worker threads are accounted for by the request-thread span
     that waits on them (e.g. retrieve-coalesce wait)."""
 
-    def __init__(self, trace_id: Optional[str] = None):
-        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+    def __init__(self, trace_id: Optional[str] = None,
+                 parent_span_id: Optional[str] = None):
+        # W3C trace-context width (32 lowercase hex — uuid4().hex exactly):
+        # the id round-trips through a ``traceparent`` header unchanged, so
+        # a UI-originated trace and the server's span tree correlate. The
+        # server-side span id identifies THIS hop (obs/logging.py emits it
+        # on every structured log line and in the response traceparent).
+        self.trace_id = trace_id or uuid.uuid4().hex
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_span_id = parent_span_id
         self.started_at = time.time()
         self.t0 = time.monotonic()
         self.end_s: Optional[float] = None
@@ -125,10 +133,13 @@ class Trace:
 
         out = {
             "trace_id": self.trace_id,
+            "span_id": self.span_id,
             "started_at": self.started_at,
             "total_ms": round(self.total_ms(), 3),
             "spans": [node(i) for i in children.get(None, [])],
         }
+        if self.parent_span_id:
+            out["parent_span_id"] = self.parent_span_id
         if self.attrs:
             out["attrs"] = self.attrs
         return out
@@ -143,9 +154,13 @@ def current_trace() -> Optional[Trace]:
     return _current.get()
 
 
-def start_trace(trace_id: Optional[str] = None) -> Trace:
-    """Open a trace on this thread; pair with ``finish_trace``."""
-    tr = Trace(trace_id)
+def start_trace(trace_id: Optional[str] = None,
+                parent_span_id: Optional[str] = None) -> Trace:
+    """Open a trace on this thread; pair with ``finish_trace``.
+    ``trace_id``/``parent_span_id`` come from an incoming W3C
+    ``traceparent`` header when the request carried one
+    (obs/logging.py:parse_traceparent)."""
+    tr = Trace(trace_id, parent_span_id=parent_span_id)
     _current.set(tr)
     return tr
 

@@ -37,7 +37,10 @@ from rag_llm_k8s_tpu.core.config import AppConfig
 from rag_llm_k8s_tpu.engine.encoder import EncoderRunner
 from rag_llm_k8s_tpu.engine.engine import InferenceEngine
 from rag_llm_k8s_tpu.index.store import VectorStore
+from rag_llm_k8s_tpu.obs import devices as obs_devices
+from rag_llm_k8s_tpu.obs import logging as obs_logging
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
+from rag_llm_k8s_tpu.obs import slo as obs_slo
 from rag_llm_k8s_tpu.obs import tracing
 from rag_llm_k8s_tpu.rag.chunking import split_text
 from rag_llm_k8s_tpu.rag.pdf import extract_text
@@ -45,6 +48,10 @@ from rag_llm_k8s_tpu.rag.prompt import assemble_context, assemble_prompt, extrac
 from rag_llm_k8s_tpu.utils.tokens import truncate_keep_eos
 
 logger = logging.getLogger(__name__)
+# one structured line per answered/failed request — emitted INSIDE the traced
+# region, so the JSON formatter (obs/logging.py) stamps it with the request's
+# trace_id/span_id and a grep of one trace id yields that request's story
+access_logger = logging.getLogger("rag_llm_k8s_tpu.access")
 
 
 def _package_version() -> str:
@@ -250,6 +257,15 @@ class RagService:
                   fn=lambda: self._pcache_stat("prefix_cache_entries"))
         reg.gauge("prefix_cache_bytes",
                   fn=lambda: self._pcache_stat("prefix_cache_bytes"))
+        # HTTP outcome accounting (route = matched path, code = status):
+        # the availability SLO's good/total source, and the 5xx-rate panel
+        self._m_http = reg.labeled_counter(
+            "rag_http_requests_total",
+            "served requests by route and status code",
+        )
+        # per-device HBM + prefix-cache residency (obs/devices.py): the
+        # dashboard view of an eviction storm under HBM pressure
+        obs_devices.register_device_gauges(reg, self._prefix_bytes_by_device)
         for e in self._engines().values():
             bind = getattr(e, "bind_metrics", None)
             if bind is not None:
@@ -258,6 +274,10 @@ class RagService:
             self.scheduler.wait_histogram = (
                 self._m_coalesce_wait.labels(stage="generate")
             )
+        # the decision layer: SLO specs evaluated over sliding windows of
+        # the histograms/counters registered above; exports rag_slo_* gauges
+        # into the same registry and backs GET /slo (obs/slo.py)
+        self.slo = obs_slo.SloEngine(reg)
 
     def _engines(self) -> Dict[int, object]:
         """The serving engines, deduped by identity (see the summing note
@@ -283,6 +303,22 @@ class RagService:
             if pcache is not None:
                 total += pcache.counters().get(name, 0)
         return total
+
+    def _prefix_bytes_by_device(self) -> Dict[int, int]:
+        """{device_id: prefix-cache bytes} summed over the serving engines
+        (rag_prefix_cache_device_bytes; empty when the cache is off)."""
+        out: Dict[int, int] = {}
+        for e in self._engines().values():
+            pcache = getattr(e, "prefix_cache", None)
+            if pcache is not None and hasattr(pcache, "bytes_by_device"):
+                for did, nbytes in pcache.bytes_by_device().items():
+                    out[did] = out.get(did, 0) + nbytes
+        return out
+
+    def observe_http(self, route: str, code: int) -> None:
+        """One served request's outcome (called once per request by the
+        route handlers — the availability SLO differences this family)."""
+        self._m_http.labels(route=route, code=str(int(code))).inc()
 
     def _batch_occupancy(self) -> float:
         """Continuous mode: active device slots; coalescing mode: the size
@@ -1206,6 +1242,7 @@ class WsgiApp:
                 Rule("/index_info", endpoint="index_info", methods=["GET"]),
                 Rule("/healthz", endpoint="healthz", methods=["GET"]),
                 Rule("/metrics", endpoint="metrics", methods=["GET"]),
+                Rule("/slo", endpoint="slo", methods=["GET"]),
                 Rule("/profile", endpoint="profile", methods=["POST"]),
                 Rule("/debug/traces", endpoint="debug_traces", methods=["GET"]),
             ]
@@ -1239,29 +1276,64 @@ class WsgiApp:
         return self._jsonify({"error": "Invalid file format"}, 400)
 
     def ep_generate(self, request):
-        tr = None
+        # W3C trace propagation (ISSUE 3): adopt the caller's trace id when
+        # the request carries a valid ``traceparent`` (the web UI originates
+        # one per click — deploy/web/app.py); a malformed header is treated
+        # exactly like no header — a fresh trace, NEVER a 500. The same
+        # trace_id then appears in the x-trace-id/traceparent response
+        # headers, the inline {"trace": true} tree, and (via the contextvar)
+        # every structured log line this request emits.
+        ctx = obs_logging.parse_traceparent(request.headers.get("traceparent"))
+        t0 = time.monotonic()
+        route = request.path
+        status = 200
+        # every request is traced into the ring buffer (/debug/traces);
+        # {"trace": true} additionally returns the span tree inline
+        tr = tracing.start_trace(
+            trace_id=ctx.trace_id if ctx else None,
+            parent_span_id=ctx.span_id if ctx else None,
+        )
+        trace_id, span_id = tr.trace_id, tr.span_id
         try:
             data = request.get_json(force=True, silent=True) or {}
             user_prompt = data.get("prompt", "")
             logger.debug("User query: %s", user_prompt)
-            # every request is traced into the ring buffer (/debug/traces);
-            # {"trace": true} additionally returns the span tree inline
-            tr = tracing.start_trace()
             tr.attrs["prompt"] = user_prompt[:80]
-            resp = self.service.answer(user_prompt)
+            body = self.service.answer(user_prompt)
+            # access line while the trace is still current (formatter
+            # stamps trace_id/span_id from the contextvar)
+            access_logger.info(
+                "request served", extra={
+                    "route": route, "status": 200,
+                    "duration_ms": round((time.monotonic() - t0) * 1e3, 2),
+                },
+            )
             tree = tracing.finish_trace(tr, self.service.traces)
             tr = None
             if data.get("trace"):
-                resp = dict(resp)
-                resp["trace"] = tree
-            return self._jsonify(resp)
+                body = dict(body)
+                body["trace"] = tree
+            resp = self._jsonify(body)
         except Exception as e:  # noqa: BLE001 — parity with rag.py:179-181
+            status = 500
             logger.exception("generate failed")
-            return self._jsonify({"error": str(e)}, 500)
+            resp = self._jsonify({"error": str(e)}, 500)
         finally:
             if tr is not None:  # error path: keep the partial trace visible
                 tr.attrs["error"] = True
+                access_logger.info(
+                    "request failed", extra={
+                        "route": route, "status": 500,
+                        "duration_ms": round((time.monotonic() - t0) * 1e3, 2),
+                    },
+                )
                 tracing.finish_trace(tr, self.service.traces)
+        resp.headers["x-trace-id"] = trace_id
+        resp.headers["traceparent"] = obs_logging.format_traceparent(
+            trace_id, span_id
+        )
+        self.service.observe_http(route, status)
+        return resp
 
     def ep_index_info(self, request):
         try:
@@ -1272,8 +1344,14 @@ class WsgiApp:
     def ep_healthz(self, request):
         svc = self.service
         ready = svc.ready
+        live = bool(request.args.get("live"))
         body = {
-            "status": "ok" if ready else "warming",
+            # ?live=1 is the LIVENESS form (deploy.yaml): 200 whenever the
+            # process can answer HTTP at all — a pod still warming (or
+            # re-warming after an engine reset) must be not-ready, not dead,
+            # or the kubelet would restart it into the same warmup
+            "status": ("alive" if live else "ok") if (ready or live)
+            else "warming",
             # fleet-dashboard segmentation fields (ISSUE 2 satellite)
             "uptime_s": round(time.monotonic() - svc.started_at, 1),
             "version": _package_version(),
@@ -1288,7 +1366,8 @@ class WsgiApp:
         except Exception:  # noqa: BLE001 — health must answer even off-JAX
             body["device_platform"] = "unknown"
             body["device_count"] = 0
-        return self._jsonify(body, 200 if ready else 503)
+        body["ready"] = ready
+        return self._jsonify(body, 200 if (ready or live) else 503)
 
     def ep_metrics(self, request):
         """One scrape sees everything (obs/metrics.py): the request/stage/
@@ -1305,6 +1384,20 @@ class WsgiApp:
             reg.render_prometheus(), status=200,
             content_type="text/plain; version=0.0.4; charset=utf-8",
         )
+
+    def ep_slo(self, request):
+        """Compliance + burn state as JSON (obs/slo.py) — computed from the
+        SAME histograms/counters ``/metrics`` exposes, so the numbers an
+        operator pages on and the numbers a dashboard plots cannot diverge.
+        ``?force=1`` bypasses the short evaluation cache."""
+        try:
+            report = self.service.slo.evaluate(
+                force=bool(request.args.get("force"))
+            )
+            return self._jsonify(report)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("slo evaluation failed")
+            return self._jsonify({"error": str(e)}, 500)
 
     def ep_debug_traces(self, request):
         """Recent request span trees from the in-memory ring buffer."""

@@ -20,7 +20,16 @@ import logging
 import os
 import threading
 
-logging.basicConfig(level=os.environ.get("TPU_RAG_LOG_LEVEL", "INFO"))
+if os.environ.get("TPU_RAG_JSON_LOGS", "").lower() in ("1", "true", "yes"):
+    # trace-correlated structured logs: every record becomes one JSON
+    # object carrying trace_id/span_id when emitted inside a traced
+    # request (obs/logging.py) — the production default for fleet log
+    # aggregation; the plain format remains for interactive runs
+    from rag_llm_k8s_tpu.obs.logging import configure_json_logging
+
+    configure_json_logging()
+else:
+    logging.basicConfig(level=os.environ.get("TPU_RAG_LOG_LEVEL", "INFO"))
 logger = logging.getLogger(__name__)
 
 
@@ -182,9 +191,9 @@ def main():
     cfg = service.config.server
     logger.info("serving on %s:%d", cfg.host, cfg.port)
     logger.info(
-        "observability: /metrics (Prometheus exposition), /debug/traces "
-        "(span-tree ring), /profile {\"seconds\": N} (background xprof) — "
-        "see docs/OBSERVABILITY.md"
+        "observability: /metrics (Prometheus exposition), /slo (error "
+        "budgets + burn rates), /debug/traces (span-tree ring), /profile "
+        "{\"seconds\": N} (background xprof) — see docs/OBSERVABILITY.md"
     )
     app.run(host=cfg.host, port=cfg.port)
 

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""CI perf gate: fail when a fresh bench JSON regresses vs the baseline.
+
+Usage (``make bench-gate`` wires the default form):
+
+    python scripts/bench_gate.py \
+        --baseline BENCH_BASELINE.json \
+        --current  /tmp/bench_fresh.json \
+        [--tolerance 0.25] [--strict] [--dry-run]
+
+Exit codes: 0 clean (or dry-run schema OK), 1 regression(s), 2 bad input.
+
+- Direction awareness lives in ``rag_llm_k8s_tpu/obs/regression.py``:
+  latency up = bad, tok/s down = bad, improvements never fail the gate.
+- ``--dry-run`` validates both documents' SCHEMA (parse + at least one
+  comparable numeric metric) without judging values — the fast ``make ci``
+  leg, which must not need a TPU.
+- A current document carrying ``"truncated": true`` (bench ran out of its
+  ``TPU_RAG_BENCH_BUDGET_S`` budget) is compared on the legs it completed;
+  the truncation is reported so a "clean" gate over half a bench is never
+  mistaken for a full pass.
+- ``--strict`` also fails on metrics missing from the current document
+  (catching a silently dropped bench leg).
+
+Stdlib + the repo only: runs everywhere tier-1 runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rag_llm_k8s_tpu.obs import regression  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--baseline", default=os.path.join(REPO, "BENCH_BASELINE.json"))
+    ap.add_argument("--current", default=None,
+                    help="fresh bench JSON (defaults to the baseline itself "
+                         "— a self-comparison smoke that must pass)")
+    ap.add_argument("--tolerance", type=float,
+                    default=regression.DEFAULT_TOLERANCE,
+                    help="relative band before a bad-direction move fails "
+                         f"(default {regression.DEFAULT_TOLERANCE})")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on metrics missing from --current")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="schema check only (no value judgment, no TPU)")
+    args = ap.parse_args(argv)
+    current_path = args.current or args.baseline
+
+    try:
+        baseline = regression.load_json(args.baseline)
+    except Exception as e:  # noqa: BLE001
+        print(f"bench-gate: cannot load baseline {args.baseline}: {e}",
+              file=sys.stderr)
+        return 2
+    try:
+        current = regression.load_json(current_path)
+    except Exception as e:  # noqa: BLE001
+        print(f"bench-gate: cannot load current {current_path}: {e}",
+              file=sys.stderr)
+        return 2
+
+    problems = regression.schema_check(baseline) + regression.schema_check(current)
+    if problems:
+        for p in problems:
+            print(f"bench-gate: schema: {p}", file=sys.stderr)
+        return 2
+    if args.dry_run:
+        n = sum(
+            1 for k, v in regression.flatten(current).items()
+            if regression.classify(k) != "ignore"
+            and isinstance(v, (int, float)) and not isinstance(v, bool)
+        )
+        print(f"bench-gate: dry-run OK ({n} comparable metrics in "
+              f"{os.path.basename(current_path)})")
+        return 0
+
+    overlap = regression.comparable_overlap(current, baseline)
+    if not overlap:
+        # zero shared comparable metrics = the gate would judge NOTHING;
+        # "OK" here would green-light any regression (schema drift, wrong
+        # file, stale baseline) — fail loudly instead
+        print(
+            "bench-gate: the two documents share no comparable metrics — "
+            "nothing would be judged. Wrong baseline/current pairing?",
+            file=sys.stderr,
+        )
+        return 2
+    findings = regression.compare(current, baseline, tolerance=args.tolerance)
+    if current.get("truncated"):
+        skipped = current.get("legs_skipped") or []
+        print("bench-gate: NOTE current bench was budget-truncated"
+              + (f" (skipped legs: {', '.join(skipped)})" if skipped else ""))
+    for f in findings["improvement"]:
+        print(f"bench-gate: improvement  {f.describe()}")
+    for f in findings["missing"]:
+        print(f"bench-gate: missing      {f.describe()}")
+    for f in findings["regression"]:
+        print(f"bench-gate: REGRESSION   {f.describe()}", file=sys.stderr)
+
+    failed = bool(findings["regression"])
+    if args.strict and any(f.current is None for f in findings["missing"]):
+        print("bench-gate: strict: metrics missing from current", file=sys.stderr)
+        failed = True
+    if failed:
+        print(f"bench-gate: FAIL ({len(findings['regression'])} regression(s) "
+              f"at tolerance {args.tolerance:.0%})", file=sys.stderr)
+        return 1
+    print(f"bench-gate: OK ({len(overlap)} metrics judged at tolerance "
+          f"{args.tolerance:.0%}; {len(findings['improvement'])} "
+          f"improvement(s), {len(findings['missing'])} missing)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,8 @@ DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 
 # registry registrations + the legacy facade's literal counter names
 _REGISTER_RE = re.compile(
-    r"\.(?:counter|gauge|histogram|labeled_histogram|labeled_counter)\(\s*"
+    r"\.(?:counter|gauge|histogram|labeled_histogram|labeled_counter|"
+    r"labeled_gauge)\(\s*"
     r"['\"]([A-Za-z_][A-Za-z0-9_]*)['\"]"
 )
 _FACADE_RE = re.compile(
