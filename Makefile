@@ -34,6 +34,14 @@ tpu-test:
 bench:
 	python bench.py
 
+# Chaos lane (ISSUE 4): the fault-injection suite with TPU_RAG_FAULTS armed
+# (enables the harness end-to-end, including the arm_from_env path), proving
+# on CPU that: a queue over cap returns 429 + Retry-After, a deadline expiry
+# mid-decode frees its slot, an injected EngineStateLost completes via
+# resubmit, and a reset storm flips /healthz readiness. docs/RESILIENCE.md.
+chaos:
+	env TPU_RAG_FAULTS=1 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q -p no:cacheprovider
+
 # Perf regression gate (scripts/bench_gate.py): compare a fresh bench JSON
 # against a committed baseline with per-metric tolerance bands, direction
 # aware (latency up = bad, tok/s down = bad). Defaults to comparing the
@@ -75,11 +83,12 @@ check: test tpu-test bench
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8); print('dryrun_multichip(8): OK')"
 
-# The no-hardware CI lane: the tier-1 gate verbatim, static checks, and a
-# fast bench-gate schema pass (validates the baseline + gate plumbing
-# without running the bench — the TPU-judged comparison is `make bench`
-# followed by `make bench-gate BENCH_CURRENT=...`).
-ci: tier1 lint
+# The no-hardware CI lane: the tier-1 gate verbatim, the chaos (fault
+# injection) suite, static checks, and a fast bench-gate schema pass
+# (validates the baseline + gate plumbing without running the bench — the
+# TPU-judged comparison is `make bench` followed by
+# `make bench-gate BENCH_CURRENT=...`).
+ci: tier1 chaos lint
 	python scripts/bench_gate.py --baseline $(BENCH_BASELINE) --dry-run
 
-.PHONY: test tier1 tpu-test bench bench-gate ci lint check validate-8b validate-70b
+.PHONY: test tier1 tpu-test bench bench-gate chaos ci lint check validate-8b validate-70b

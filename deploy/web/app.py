@@ -7,12 +7,18 @@ request web → server → span tree → structured logs (the server echoes it i
 ``x-trace-id``; paste it into ``GET /debug/traces`` or the log search)."""
 
 import os
+import time
 import uuid
 
 import requests
 import streamlit as st
 
 LLM_SERVICE_URL = os.environ.get("LLM_SERVICE_URL", "http://llm-service:80")
+# (connect, read) timeouts: connect fails fast on a dead service; read covers
+# a full cold-bucket generate. Without these, a wedged server pinned the
+# Streamlit spinner forever (requests' default is NO timeout).
+CONNECT_TIMEOUT_S = float(os.environ.get("LLM_CONNECT_TIMEOUT_S", "5"))
+READ_TIMEOUT_S = float(os.environ.get("LLM_READ_TIMEOUT_S", "180"))
 
 
 def new_traceparent() -> str:
@@ -21,18 +27,65 @@ def new_traceparent() -> str:
     return f"00-{uuid.uuid4().hex}-{uuid.uuid4().hex[:16]}-01"
 
 
+def post_generate(prompt: str, traceparent: str, status_slot):
+    """One /generate POST with ONE retry on connection errors and on
+    overload sheds (429/503), honoring the server's ``Retry-After`` —
+    the client half of the admission-control contract. Distinguishes
+    'overloaded, retrying' from a hard failure in the UI instead of
+    hanging the spinner."""
+    last_exc = None
+    for attempt in (0, 1):
+        try:
+            resp = requests.post(
+                f"{LLM_SERVICE_URL}/generate",
+                json={"prompt": prompt},
+                headers={"traceparent": traceparent},
+                timeout=(CONNECT_TIMEOUT_S, READ_TIMEOUT_S),
+            )
+        except (requests.ConnectionError, requests.Timeout) as e:
+            last_exc = e
+            if attempt == 0:
+                status_slot.warning("Connection problem — retrying…")
+                time.sleep(1.0)
+                continue
+            raise
+        if resp.status_code in (429, 503) and attempt == 0:
+            try:
+                wait_s = float(resp.headers.get("Retry-After", "1"))
+            except ValueError:
+                wait_s = 1.0
+            status_slot.warning(
+                f"Server overloaded ({resp.status_code}) — retrying in "
+                f"{wait_s:.0f}s…"
+            )
+            time.sleep(min(wait_s, 10.0))
+            continue
+        return resp
+    raise last_exc  # pragma: no cover — both attempts raised
+
+
 st.title("RAG LLM (TPU)")
 
 prompt = st.text_input("Enter your prompt:")
 if st.button("Generate") and prompt:
     traceparent = new_traceparent()
-    with st.spinner("Generating..."):
-        resp = requests.post(
-            f"{LLM_SERVICE_URL}/generate",
-            json={"prompt": prompt},
-            headers={"traceparent": traceparent},
+    status_slot = st.empty()
+    try:
+        with st.spinner("Generating..."):
+            resp = post_generate(prompt, traceparent, status_slot)
+    except (requests.ConnectionError, requests.Timeout) as e:
+        status_slot.empty()
+        st.error(f"Could not reach the LLM service: {e}")
+        st.stop()
+    status_slot.empty()
+    if resp.status_code in (429, 503):
+        body_text = resp.text
+        st.error(
+            "The server is overloaded and still shedding load after a "
+            f"retry (HTTP {resp.status_code}). Please try again shortly. "
+            f"Details: {body_text}"
         )
-    if resp.status_code == 200:
+    elif resp.status_code == 200:
         body = resp.json()
         st.write(body.get("generated_text", ""))
         timings = body.get("timings")

@@ -437,6 +437,40 @@ class EngineConfig:
 
 
 @dataclass(frozen=True)
+class ResilienceConfig:
+    """Admission control, deadlines, and failure-recovery knobs (ISSUE 4 —
+    rag_llm_k8s_tpu/resilience/). Defaults are sized for one pod of the
+    reference deployment: concurrency ~2× the batch cap (keeps the coalescer
+    fed), a queue a few seconds deep, and a 120 s default deadline matching
+    the seed's only hardcoded timeout."""
+
+    # concurrent requests allowed past the gate into the serving pipeline
+    # (env TPU_RAG_ADMISSION_MAX_CONCURRENCY)
+    admission_max_concurrency: int = 16
+    # bounded wait line above the concurrency cap; request #(cap+queue+1)
+    # is shed with 429 + Retry-After (env TPU_RAG_ADMISSION_MAX_QUEUE)
+    admission_max_queue: int = 64
+    # the Retry-After hint on queue_full sheds, seconds
+    # (env TPU_RAG_ADMISSION_RETRY_AFTER_S)
+    admission_retry_after_s: float = 1.0
+    # default end-to-end request deadline when the client sends none
+    # (body deadline_ms / x-request-deadline-ms header); replaces the
+    # hardcoded th.join(timeout=120) (env TPU_RAG_DEADLINE_MS)
+    deadline_ms: int = 120_000
+    # circuit breaker: this many engine resets inside breaker_window_s
+    # flips /healthz readiness to 503 so Kubernetes drains the pod
+    # (env TPU_RAG_BREAKER_RESETS / TPU_RAG_BREAKER_WINDOW_S)
+    breaker_reset_threshold: int = 3
+    breaker_window_s: float = 300.0
+    # reset recovery: resubmissions per in-flight request after an
+    # EngineStateLost (0 restores fail-on-first-fault), and the jittered
+    # backoff before the resubmitted prefills land on the device again
+    # (env TPU_RAG_INFLIGHT_RETRIES / TPU_RAG_RETRY_BACKOFF_MS)
+    inflight_retries: int = 1
+    retry_backoff_ms: float = 50.0
+
+
+@dataclass(frozen=True)
 class ServerConfig:
     """HTTP surface + storage paths; parity with rag.py:18-20,204 and
     web/app.py:5."""
@@ -473,6 +507,7 @@ class AppConfig:
     sampling: SamplingConfig = field(default_factory=SamplingConfig)
     engine: EngineConfig = field(default_factory=EngineConfig)
     server: ServerConfig = field(default_factory=ServerConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     system_message: str = SYSTEM_MESSAGE
 
     @classmethod
@@ -591,6 +626,33 @@ class AppConfig:
                     engine.prefix_cache, hbm_budget_mb=mb
                 ),
             )
+        resilience = cfg.resilience
+
+        def _res_int(var: str, field_name: str, minimum: int):
+            nonlocal resilience
+            if var in env:
+                v = int(env[var])
+                if v < minimum:
+                    raise ValueError(f"{var}={v}: expected >= {minimum}")
+                resilience = dataclasses.replace(resilience, **{field_name: v})
+
+        def _res_float(var: str, field_name: str, minimum: float):
+            nonlocal resilience
+            if var in env:
+                v = float(env[var])
+                if v < minimum:
+                    raise ValueError(f"{var}={v}: expected >= {minimum}")
+                resilience = dataclasses.replace(resilience, **{field_name: v})
+
+        _res_int("TPU_RAG_ADMISSION_MAX_CONCURRENCY", "admission_max_concurrency", 1)
+        _res_int("TPU_RAG_ADMISSION_MAX_QUEUE", "admission_max_queue", 0)
+        _res_float("TPU_RAG_ADMISSION_RETRY_AFTER_S", "admission_retry_after_s", 0.0)
+        _res_int("TPU_RAG_DEADLINE_MS", "deadline_ms", 1)
+        _res_int("TPU_RAG_BREAKER_RESETS", "breaker_reset_threshold", 1)
+        _res_float("TPU_RAG_BREAKER_WINDOW_S", "breaker_window_s", 1.0)
+        _res_int("TPU_RAG_INFLIGHT_RETRIES", "inflight_retries", 0)
+        _res_float("TPU_RAG_RETRY_BACKOFF_MS", "retry_backoff_ms", 0.0)
         return dataclasses.replace(
-            cfg, server=server, mesh=mesh, sampling=sampling, engine=engine
+            cfg, server=server, mesh=mesh, sampling=sampling, engine=engine,
+            resilience=resilience,
         )

@@ -16,6 +16,7 @@ every request in a batch shares one executable.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -23,6 +24,24 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+
+logger = logging.getLogger(__name__)
+
+
+def _join_worker(worker: threading.Thread, counter, what: str, timeout: float = 5.0):
+    """Join a scheduler/coalescer worker, loudly: a worker that outlives the
+    join window (wedged in a device call) used to vanish in silence — the
+    drains still unblock every caller, but the leak should be visible on a
+    dashboard (``rag_scheduler_join_timeouts_total``) and in the logs."""
+    worker.join(timeout=timeout)
+    if worker.is_alive():
+        logger.warning(
+            "%s worker still alive after join(%gs); queued callers have "
+            "been failed fast but the worker thread may be wedged",
+            what, timeout,
+        )
+        if counter is not None:
+            counter.inc()
 
 
 @dataclass
@@ -87,6 +106,8 @@ class Coalescer:
         # pending_hint): per-item enqueue→dispatch wait — the coalesce
         # window's real cost per request on a dashboard
         self.wait_histogram = None
+        # optional obs Counter — shutdown join timeouts (see _join_worker)
+        self.join_timeout_counter = None
         self._queue: "queue.Queue[_PendingItem]" = queue.Queue()
         self._stop = threading.Event()
         self._lifecycle_lock = threading.Lock()
@@ -108,7 +129,7 @@ class Coalescer:
     def shutdown(self):
         self._stop.set()
         self._queue.put(None)
-        self._worker.join(timeout=5)
+        _join_worker(self._worker, self.join_timeout_counter, "coalescer")
 
     def _run(self):
         try:
@@ -206,6 +227,8 @@ class BatchScheduler:
         self.pending_hint = pending_hint
         # optional obs Histogram — see Coalescer.wait_histogram
         self.wait_histogram = None
+        # optional obs Counter — shutdown join timeouts (see _join_worker)
+        self.join_timeout_counter = None
         # size of the batch currently inside engine.generate (0 between
         # dispatches) — the rag_batch_occupancy gauge reads this; plain
         # int assignment, so no lock needed for the scrape-time read
@@ -226,8 +249,17 @@ class BatchScheduler:
         max_new_tokens: Optional[int] = None,
         seed: Optional[int] = None,
         timeout: Optional[float] = None,
+        deadline=None,  # Optional[resilience.Deadline]
     ) -> List[int]:
-        """Blocking: enqueue and wait for this prompt's continuation."""
+        """Blocking: enqueue and wait for this prompt's continuation.
+
+        A ``deadline`` bounds the wait (the caller's remaining budget); the
+        batch itself cannot be cancelled mid-generate — one-shot generation
+        is a single device call — so expiry surfaces as the caller's
+        :class:`DeadlineExceeded` while the batch completes for its
+        surviving members."""
+        if timeout is None and deadline is not None:
+            timeout = deadline.wait_timeout()
         item = _Pending(prompt=list(prompt), max_new=max_new_tokens, seed=seed)
         with self._lifecycle_lock:  # stop-check + enqueue must be atomic
             if self._stop.is_set():
@@ -242,7 +274,7 @@ class BatchScheduler:
     def shutdown(self):
         self._stop.set()
         self._queue.put(None)  # wake the worker
-        self._worker.join(timeout=5)
+        _join_worker(self._worker, self.join_timeout_counter, "batch-scheduler")
 
     # ------------------------------------------------------------------
     def _run(self):

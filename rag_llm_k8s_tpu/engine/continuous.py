@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import logging
 import queue
+import random
 import threading
 import time
 from dataclasses import dataclass, field
@@ -59,6 +60,8 @@ from rag_llm_k8s_tpu.engine.engine import (
 from rag_llm_k8s_tpu.engine.sampling import sample_token_per_row
 from rag_llm_k8s_tpu.models.llama import LlamaModel, make_kv_cache, mask_window
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
+from rag_llm_k8s_tpu.resilience import faults
+from rag_llm_k8s_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from rag_llm_k8s_tpu.utils.buckets import bucket_len
 
 logger = logging.getLogger(__name__)
@@ -660,6 +663,25 @@ class ContinuousEngine:
     def has_active(self) -> bool:
         return any(s.active for s in self.slots)
 
+    def evict_requests(self, request_ids: Sequence[int]) -> List[int]:
+        """Deactivate the slots serving ``request_ids`` (deadline eviction):
+        the rows stop attending/advancing on the very next step and their
+        slots are immediately admissible again. Reuses the same deactivate
+        mask the budget-retire path applies after ``step()`` — eviction is
+        a retire without a result. Returns the freed row indices."""
+        wanted = set(request_ids)
+        rows = [
+            i for i, s in enumerate(self.slots)
+            if s.active and s.request_id in wanted
+        ]
+        if rows:
+            m = np.ones(self.B, bool)
+            m[rows] = False
+            self._active = self._active & self._put(jnp.asarray(m))
+            for r in rows:
+                self.slots[r] = _Slot()
+        return rows
+
     def admit(
         self,
         request_id: int,
@@ -766,6 +788,9 @@ class ContinuousEngine:
             self.params, self._put(tokens), self._put(mask), self._put(folded)
         )
         try:
+            # fault site "insert": models a device fault inside the donated
+            # splice — the handler below must reset and raise EngineStateLost
+            faults.maybe_fail("insert")
             # insert dispatches BEFORE the tok0 fetch: the splice runs on
             # device while the first tokens cross the host link
             (self._cache, self._kv_start, self._kv_len,
@@ -827,6 +852,7 @@ class ContinuousEngine:
         """``decode_sync_steps`` decode steps for every active slot in one
         device call + one host fetch. Returns completed requests as
         ``(request_id, tokens)`` and frees their slots."""
+        faults.maybe_fail("decode_step")
         k = self.sync_steps
         t0 = time.perf_counter()
         (self._cache, self._kv_len, self._last_tok, toks, eoss,
@@ -875,10 +901,38 @@ class ContinuousEngine:
 
 class ContinuousScheduler:
     """Thread-safe facade: ``submit()`` blocks the caller; a dispatcher
-    thread owns the engine, admitting between decode steps."""
+    thread owns the engine, admitting between decode steps.
 
-    def __init__(self, engine: ContinuousEngine):
+    Resilience behavior (ISSUE 4):
+
+    - **deadline eviction**: a submit carrying a :class:`Deadline` that
+      expires mid-decode has its slot EVICTED within one scheduler
+      iteration (``engine.evict_requests``) — the abandoned request stops
+      burning a decode slot the moment its client has given up;
+    - **reset recovery**: an :class:`EngineStateLost` (the reset wiped every
+      slot) RESUBMITS the in-flight prompts once, after a jittered backoff,
+      with each request's token budget reduced by what it already emitted
+      (the emitted tokens are appended to the resubmitted prompt, so the
+      client still receives one seamless continuation). A single transient
+      device fault is therefore invisible to callers; a second fault on the
+      same request fails it (``rag_inflight_retries_total{outcome}``);
+    - **breaker feed**: every reset is reported to the attached
+      :class:`~rag_llm_k8s_tpu.resilience.breaker.CircuitBreaker` (set by
+      the service) — a reset storm flips readiness, Kubernetes drains the
+      pod, and admission sheds with 503 in the meantime.
+    """
+
+    def __init__(
+        self,
+        engine: ContinuousEngine,
+        retries: int = 1,
+        retry_backoff_s: float = 0.05,
+    ):
         self.engine = engine
+        self.retries = max(0, retries)
+        self.retry_backoff_s = max(0.0, retry_backoff_s)
+        # set by the service: engine resets feed the readiness breaker
+        self.breaker = None
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
         self._stop = threading.Event()
         self._next_id = 0
@@ -887,10 +941,35 @@ class ContinuousScheduler:
         # final drain — without it an item can land in the queue after the
         # drain and block its caller forever
         self._lifecycle_lock = threading.Lock()
+        self.bind_metrics(obs_metrics.default_registry())
         self._worker = threading.Thread(
             target=self._run, daemon=True, name="continuous-scheduler"
         )
         self._worker.start()
+
+    def bind_metrics(self, registry) -> None:
+        """Resilience accounting (service rebinds, like the engines)."""
+        self._m_resets = registry.counter(
+            "rag_engine_resets_total",
+            "engine state resets (EngineStateLost / failed decode steps)",
+        )
+        self._m_retries = registry.labeled_counter(
+            "rag_inflight_retries_total",
+            "in-flight requests resubmitted after an engine reset "
+            "(outcome: resubmitted | succeeded | gave_up)",
+        )
+        for o in ("resubmitted", "succeeded", "gave_up"):
+            self._m_retries.labels(outcome=o)
+        dl_fam = registry.labeled_counter(
+            "rag_deadline_exceeded_total",
+            "requests failed by their end-to-end deadline (stage label)",
+        )
+        self._m_deadline_queue = dl_fam.labels(stage="queue")
+        self._m_deadline_decode = dl_fam.labels(stage="decode")
+        self._m_join_timeout = registry.counter(
+            "rag_scheduler_join_timeouts_total",
+            "scheduler shutdowns whose worker thread outlived join(timeout)",
+        )
 
     def submit(
         self,
@@ -898,6 +977,7 @@ class ContinuousScheduler:
         max_new_tokens: Optional[int] = None,
         seed: Optional[int] = None,  # honored per-row: draws are seed+position keyed
         timeout: Optional[float] = None,
+        deadline: Optional[Deadline] = None,
     ) -> List[int]:
         if self._stop.is_set():
             raise RuntimeError("scheduler is shut down")
@@ -911,23 +991,39 @@ class ContinuousScheduler:
             self._next_id += 1
             rid = self._next_id
         item = _Pending(
-            request_id=rid, prompt=list(prompt), max_new=max_new, seed=seed
+            request_id=rid, prompt=list(prompt), max_new=max_new, seed=seed,
+            deadline=deadline, retries_left=self.retries,
         )
         with self._lifecycle_lock:  # stop-check + enqueue must be atomic
             if self._stop.is_set():
                 raise RuntimeError("scheduler is shut down")
             self._queue.put(item)
-        if not item.done.wait(timeout):
+        wait_t = timeout
+        if wait_t is None and deadline is not None:
+            # small grace past the deadline: the worker evicts the row and
+            # delivers a stage-precise error within one iteration — prefer
+            # that over racing it with a caller-side raise
+            wait_t = deadline.wait_timeout() + 0.25
+        if not item.done.wait(wait_t):
+            if deadline is not None and deadline.expired():
+                # the worker's eviction sweep frees the slot; the caller
+                # need not (and must not) block on it. Mark the item so the
+                # sweep skips ITS deadline-counter increment — this expiry
+                # is counted once, at the caller's stage="generate"
+                item.abandoned = True
+                raise DeadlineExceeded("generate", deadline.budget_ms)
             raise TimeoutError("generation timed out")
         if item.error is not None:
             raise item.error
         return item.result
 
     def shutdown(self):
+        from rag_llm_k8s_tpu.engine.batching import _join_worker
+
         self._stop.set()
         with self._lifecycle_lock:
             self._queue.put(None)
-        self._worker.join(timeout=5)
+        _join_worker(self._worker, self._m_join_timeout, "continuous-scheduler")
         # the worker's own drain ran before join returned; under the lock no
         # new item can have been enqueued since — sweep anything that raced
         # in between the worker's drain and _stop becoming visible
@@ -948,6 +1044,11 @@ class ContinuousScheduler:
         try:
             item = self._run_loop(waiting)
         finally:
+            # the worker is exiting for WHATEVER reason (shutdown() or an
+            # unguarded exception): close the door FIRST so post-mortem
+            # submits fail fast instead of enqueueing into a drained queue
+            # and blocking their caller forever
+            self._stop.set()
             # fail everything still in flight or queued so no caller blocks
             # forever on a scheduler that has stopped (answer() submits with
             # timeout=None)
@@ -956,13 +1057,14 @@ class ContinuousScheduler:
             waiting.clear()
             if item is not None:
                 leftovers.append(item)
-            while True:
-                try:
-                    queued = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                if queued is not None:
-                    leftovers.append(queued)
+            with self._lifecycle_lock:  # no submit can race this drain
+                while True:
+                    try:
+                        queued = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if queued is not None:
+                        leftovers.append(queued)
             for it in leftovers:
                 it.error = err
                 it.done.set()
@@ -971,6 +1073,9 @@ class ContinuousScheduler:
         """Returns the un-acked in-hand item (if any) when stopping."""
         eng = self.engine
         while not self._stop.is_set():
+            # deadline sweep once per iteration: an expired in-flight request
+            # frees its decode slot within ONE scheduler step
+            self._evict_expired(waiting)
             if eng.has_active():
                 # decode never waits on arrivals: peek, admit, step
                 try:
@@ -982,10 +1087,16 @@ class ContinuousScheduler:
             while item is not None:  # admit everything that fits right now
                 if self._stop.is_set():
                     return item  # un-acked: the finally drain fails it
+                if self._expire_queued(item):
+                    # expired while queued: fail fast, never admit — under
+                    # overload this is what keeps dead work off the device
+                    item = self._next_nowait()
+                    continue
                 free = eng.free_slots()
                 if not free:
                     # no room: decode until a slot frees, then admit
                     self._safe_step(waiting)
+                    self._evict_expired(waiting)
                     continue
                 # GROUP admission: drain whatever else is already queued up
                 # to the free-slot count — the engine batches same-bucket
@@ -998,6 +1109,8 @@ class ContinuousScheduler:
                         break
                     if nxt is None:
                         break
+                    if self._expire_queued(nxt):
+                        continue  # dead on arrival: no prefill for it
                     batch.append(nxt)
                 try:
                     admitted = eng.admit_many(
@@ -1013,55 +1126,150 @@ class ContinuousScheduler:
                         _, finished = res
                         # the first token exists the moment admission
                         # returns (sampled at prefill): submit → here IS
-                        # the request's exact TTFT, queue wait included
-                        eng._m_ttft.observe(time.monotonic() - b.t_submit)
+                        # the request's exact TTFT, queue wait included.
+                        # A resubmitted request already observed its real
+                        # TTFT on the first attempt — a second sample would
+                        # double-count it and fold the reset backoff into
+                        # the histogram the SLO layer alerts on
+                        if not b.retried:
+                            eng._m_ttft.observe(time.monotonic() - b.t_submit)
                         if finished is not None:
-                            b.result = finished
-                            b.done.set()
+                            self._deliver(b, finished)
                         else:
                             waiting[b.request_id] = b
+                except EngineStateLost as e:
+                    # the reset (inside the engine) wiped every slot: recover
+                    # by resubmitting this batch AND the in-flight requests —
+                    # their emitted tokens were lost with the slots, so they
+                    # restart from their original prompts
+                    self._handle_reset(e, waiting, extra=batch, emitted={})
                 except BaseException as e:  # noqa: BLE001 — deliver to waiters
                     for b in batch:
                         b.error = e
                         b.done.set()
-                    if isinstance(e, EngineStateLost):
-                        # the reset wiped every in-flight slot: their
-                        # requests can never complete — fail them now
-                        for w in waiting.values():
-                            w.error = e
-                            w.done.set()
-                        waiting.clear()
-                try:
-                    item = self._queue.get_nowait()
-                except queue.Empty:
-                    item = None
+                item = self._next_nowait()
             if eng.has_active():
                 self._safe_step(waiting)
         return None
 
+    def _next_nowait(self) -> Optional["_Pending"]:
+        try:
+            return self._queue.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _evict_expired(self, waiting: Dict[int, "_Pending"]):
+        """Evict in-flight requests whose deadline has passed: free their
+        device slots and deliver the stage-precise error."""
+        expired = [
+            rid for rid, it in waiting.items()
+            if it.deadline is not None and it.deadline.expired()
+        ]
+        if not expired:
+            return
+        self.engine.evict_requests(expired)
+        for rid in expired:
+            it = waiting.pop(rid)
+            if not it.abandoned:  # the caller already counted its expiry
+                self._m_deadline_decode.inc()
+            it.error = DeadlineExceeded("decode", it.deadline.budget_ms)
+            it.done.set()
+
+    def _expire_queued(self, item: "_Pending") -> bool:
+        """Fail an expired item straight out of the queue (stage=queue) —
+        dead work must never reach the device. True when it was expired."""
+        if item.deadline is None or not item.deadline.expired():
+            return False
+        if not item.abandoned:
+            self._m_deadline_queue.inc()
+        item.error = DeadlineExceeded("queue", item.deadline.budget_ms)
+        item.done.set()
+        return True
+
+    def _deliver(self, item: "_Pending", tokens: List[int]):
+        """Complete one request: tokens emitted before a recovered reset
+        (if any) prepend the continuation — the client sees one stream."""
+        if item.retried:
+            self._m_retries.labels(outcome="succeeded").inc()
+        item.result = item.emitted + tokens
+        item.done.set()
+
+    def _handle_reset(self, cause, waiting, extra, emitted):
+        """After an engine reset: resubmit what can still be served, fail
+        the rest. ``emitted`` maps request_id → tokens produced before the
+        reset (captured from the host slots when the failure site allows);
+        resubmitted prompts carry them so decode resumes where it stopped
+        and the budget shrinks by what was already produced."""
+        self._m_resets.inc()
+        if self.breaker is not None:
+            self.breaker.record_reset()
+        items = list(waiting.values()) + list(extra)
+        waiting.clear()
+        retry = []
+        for it in items:
+            expired = it.deadline is not None and it.deadline.expired()
+            if it.retries_left > 0 and not expired and not self._stop.is_set():
+                retry.append(it)
+            else:
+                self._m_retries.labels(outcome="gave_up").inc()
+                it.error = cause
+                it.done.set()
+        if not retry:
+            return
+        logger.warning(
+            "engine reset (%s); resubmitting %d in-flight request(s)",
+            cause, len(retry),
+        )
+        if self.retry_backoff_s > 0:
+            # jittered: a device that just faulted gets a beat before the
+            # retries' prefills land on it again
+            time.sleep(random.uniform(0.5, 1.0) * self.retry_backoff_s)
+        largest = max(self.engine.buckets)
+        for it in retry:
+            toks = emitted.get(it.request_id, [])
+            # resume only when prompt+emitted still fits a slot — past the
+            # largest bucket admit_many would silently left-truncate the
+            # context and the "seamless continuation" would be conditioned
+            # on a different prompt; restarting from scratch is exact
+            if toks and len(it.prompt) + len(toks) <= largest:
+                it.emitted.extend(toks)
+                it.prompt = list(it.prompt) + toks
+                it.max_new = max(1, it.max_new - len(toks))
+            it.retries_left -= 1
+            it.retried = True
+            self._m_retries.labels(outcome="resubmitted").inc()
+            self._queue.put(it)
+
     def _safe_step(self, waiting: Dict[int, "_Pending"]):
-        """One decode step that can never kill the dispatcher: a device error
-        fails every in-flight request (instead of hanging their callers
-        forever) and resets the slots so the loop keeps serving."""
+        """One decode step that can never kill the dispatcher: a device
+        error resets the slots and RESUBMITS the in-flight requests (once
+        each) so a transient fault stays invisible to callers; requests out
+        of retries (or past deadline) get the error instead of a hang."""
         try:
             self._drain_done(self.engine.step(), waiting)
-        except BaseException as e:  # noqa: BLE001 — deliver, don't die
+        except BaseException as e:  # noqa: BLE001 — recover, don't die
             logger.exception(
-                "decode step failed; failing %d in-flight request(s)", len(waiting)
+                "decode step failed; recovering %d in-flight request(s)",
+                len(waiting),
             )
-            for item in waiting.values():
-                item.error = e
-                item.done.set()
-            waiting.clear()
-            self.engine.reset()
+            # capture what each in-flight request already produced BEFORE
+            # reset() wipes the host slots — the resubmission resumes from
+            # the original prompt + these tokens
+            emitted = {
+                s.request_id: list(s.tokens)
+                for s in self.engine.slots if s.active
+            }
+            try:
+                self.engine.reset()
+            except BaseException:  # noqa: BLE001 — a failed reset must not kill the loop
+                logger.exception("engine reset failed after step failure")
+            self._handle_reset(e, waiting, extra=[], emitted=emitted)
 
-    @staticmethod
-    def _drain_done(done, waiting):
+    def _drain_done(self, done, waiting):
         for rid, tokens in done:
             item = waiting.pop(rid, None)
             if item is not None:
-                item.result = tokens
-                item.done.set()
+                self._deliver(item, tokens)
 
 
 @dataclass
@@ -1074,3 +1282,8 @@ class _Pending:
     result: Optional[List[int]] = None
     error: Optional[BaseException] = None
     t_submit: float = field(default_factory=time.monotonic)  # TTFT anchor
+    deadline: Optional[Deadline] = None
+    retries_left: int = 0  # reset-recovery resubmissions remaining
+    retried: bool = False  # ever resubmitted (success/failure accounting)
+    emitted: List[int] = field(default_factory=list)  # pre-reset tokens
+    abandoned: bool = False  # caller gave up (it counted the expiry)

@@ -18,6 +18,7 @@ import numpy as np
 from rag_llm_k8s_tpu.core.config import DTypePolicy, EncoderConfig
 from rag_llm_k8s_tpu.core.mesh import MeshContext
 from rag_llm_k8s_tpu.models.bge_m3 import BgeM3Encoder
+from rag_llm_k8s_tpu.resilience import faults
 from rag_llm_k8s_tpu.utils.buckets import bucket_len, next_pow2
 from rag_llm_k8s_tpu.utils.tokens import truncate_keep_eos
 
@@ -81,6 +82,7 @@ class EncoderRunner:
         """
         if not token_lists:
             return np.zeros((0, self.config.hidden_size), np.float32)
+        faults.maybe_fail("embed")
         out = np.zeros((len(token_lists), self.config.hidden_size), np.float32)
         # group by length bucket to minimize padding waste
         order = sorted(range(len(token_lists)), key=lambda i: len(token_lists[i]))

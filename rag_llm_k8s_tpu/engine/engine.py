@@ -51,6 +51,7 @@ from rag_llm_k8s_tpu.models.llama import (
     mask_window,
 )
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
+from rag_llm_k8s_tpu.resilience import faults
 from rag_llm_k8s_tpu.utils.buckets import bucket_len, next_pow2
 
 logger = logging.getLogger(__name__)
@@ -1247,6 +1248,7 @@ class InferenceEngine:
         """
         if not prompts:
             return []
+        faults.maybe_fail("generate")
         max_new = (
             self.sampling.max_new_tokens if max_new_tokens is None else max_new_tokens
         )

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from rag_llm_k8s_tpu.ops.knn import BIG, knn_topk
+from rag_llm_k8s_tpu.resilience import faults
 from rag_llm_k8s_tpu.utils.buckets import next_pow2
 
 _FORMAT_VERSION = 1
@@ -530,6 +531,7 @@ class VectorStore:
         """Materialize SearchResults for externally computed (idx, dists) —
         the fused embed+kNN serving path ranks on device and only the final
         k indices ever reach the host."""
+        faults.maybe_fail("store_lookup")
         return [
             SearchResult(metadata=self._metadata[int(i)], distance=float(d), row=int(i))
             for d, i in zip(dists, idx)

@@ -1,0 +1,35 @@
+"""Resilience layer: the serving path's behavior UNDER stress.
+
+PR 2/3 built the telemetry and the SLO decision layer; this package is the
+*actuation* side — what the service does when the signals go red instead of
+just reporting them:
+
+- :mod:`admission` — a bounded admission gate in front of both engine modes.
+  Over-cap requests get an immediate 429/503 with ``Retry-After`` instead of
+  an unbounded queue wait (the seed's ``queue.Queue`` grows without limit
+  under a burst);
+- :mod:`deadline` — end-to-end per-request deadlines checked at every stage
+  boundary, with mid-decode slot eviction in the continuous engine so an
+  abandoned request stops burning a decode slot;
+- :mod:`breaker` — a sliding-window circuit breaker over engine resets:
+  N resets inside the window flip ``/healthz`` readiness to 503 so
+  Kubernetes drains the pod instead of hammering a sick device;
+- :mod:`faults` — a deterministic fault-injection harness (named sites,
+  armed via ``TPU_RAG_FAULTS`` or the debug endpoint) that lets the chaos
+  suite prove shedding, eviction, recovery, and breaker behavior on CPU.
+
+Everything here is stdlib-only on purpose: the injection sites live in
+modules (store, encoder) that must stay importable without JAX warm.
+"""
+
+from rag_llm_k8s_tpu.resilience.admission import AdmissionController, AdmissionRejected
+from rag_llm_k8s_tpu.resilience.breaker import CircuitBreaker
+from rag_llm_k8s_tpu.resilience.deadline import Deadline, DeadlineExceeded
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionRejected",
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExceeded",
+]

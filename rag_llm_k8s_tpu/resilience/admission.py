@@ -1,0 +1,133 @@
+"""Bounded admission control + load shedding for the serving path.
+
+The seed admitted every request unconditionally: concurrent ``/generate``
+calls piled threads onto an unbounded ``queue.Queue`` behind the scheduler,
+so a burst beyond the device's throughput grew the queue (and every queued
+request's latency) without bound — the classic metastable overload shape.
+The gate in front of the pipeline makes overload a *fast, explicit* signal
+instead:
+
+- up to ``max_concurrency`` requests run concurrently;
+- up to ``max_queue`` more wait (bounded, deadline-aware);
+- everything beyond that is REJECTED immediately with a machine-readable
+  reason and a ``Retry-After`` hint — a 429 the client's retry loop can
+  honor costs microseconds; a queued request that times out after 120 s
+  costs a thread, a socket, and a user.
+
+The gate also fronts the circuit breaker: while the breaker is open the pod
+is draining, so new work is shed with 503 + ``Retry-After`` equal to the
+breaker's estimated close time.
+
+``rag_admission_rejected_total{reason}`` counts every shed request; the
+live ``waiting`` count folds into ``rag_admission_queue_depth``.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+from rag_llm_k8s_tpu.resilience.breaker import CircuitBreaker
+from rag_llm_k8s_tpu.resilience.deadline import Deadline, DeadlineExceeded
+
+__all__ = ["AdmissionController", "AdmissionRejected"]
+
+
+class AdmissionRejected(RuntimeError):
+    """Load shed at the gate. ``status`` is the HTTP code the edge maps it
+    to (429 = over capacity, retry; 503 = draining/breaker, go elsewhere)."""
+
+    def __init__(self, reason: str, status: int, retry_after_s: float):
+        super().__init__(f"admission rejected: {reason}")
+        self.reason = reason
+        self.status = status
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        max_concurrency: int = 16,
+        max_queue: int = 64,
+        retry_after_s: float = 1.0,
+        breaker: Optional[CircuitBreaker] = None,
+    ):
+        if max_concurrency < 1:
+            raise ValueError(f"max_concurrency={max_concurrency}: expected >= 1")
+        if max_queue < 0:
+            raise ValueError(f"max_queue={max_queue}: expected >= 0")
+        self.max_concurrency = max_concurrency
+        self.max_queue = max_queue
+        self.retry_after_s = retry_after_s
+        self.breaker = breaker
+        self._cv = threading.Condition()
+        self.active = 0
+        self.waiting = 0
+        # set by the service (obs wiring): labeled-counter families for
+        # rag_admission_rejected_total / rag_deadline_exceeded_total —
+        # None keeps the gate standalone
+        self.reject_counter = None
+        self.deadline_counter = None
+
+    # -- internals -------------------------------------------------------
+    def _reject(self, reason: str, status: int, retry_after_s: float):
+        fam = self.reject_counter
+        if fam is not None:
+            fam.labels(reason=reason).inc()
+        raise AdmissionRejected(reason, status, retry_after_s)
+
+    def _acquire(self, deadline: Optional[Deadline]) -> None:
+        breaker = self.breaker
+        if breaker is not None and breaker.open:
+            # draining: shed EVERYTHING, even below the concurrency cap —
+            # the whole point is to stop feeding a sick device
+            self._reject(
+                "breaker_open", 503,
+                max(breaker.retry_after_s(), self.retry_after_s),
+            )
+        with self._cv:
+            if self.active < self.max_concurrency and self.waiting == 0:
+                self.active += 1
+                return
+            if self.waiting >= self.max_queue:
+                self._reject("queue_full", 429, self.retry_after_s)
+            self.waiting += 1
+            try:
+                while self.active >= self.max_concurrency:
+                    if deadline is not None:
+                        if deadline.expired():
+                            fam = self.deadline_counter
+                            if fam is not None:
+                                fam.labels(stage="queue").inc()
+                            raise DeadlineExceeded("queue", deadline.budget_ms)
+                        self._cv.wait(timeout=deadline.wait_timeout())
+                    else:
+                        self._cv.wait()
+                self.active += 1
+            finally:
+                self.waiting -= 1
+
+    def _release(self) -> None:
+        with self._cv:
+            self.active -= 1
+            self._cv.notify()
+
+    # -- public ----------------------------------------------------------
+    @contextmanager
+    def admit(self, deadline: Optional[Deadline] = None):
+        """Hold one admission slot for the duration of the request.
+
+        Raises :class:`AdmissionRejected` (shed) or
+        :class:`DeadlineExceeded` (stage ``queue``) instead of waiting
+        unboundedly.
+        """
+        self._acquire(deadline)
+        try:
+            yield
+        finally:
+            self._release()
+
+    def queue_depth(self) -> int:
+        """Requests currently waiting at the gate (for the depth gauge)."""
+        return self.waiting

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import io
 import logging
+import math
 import os
 import threading
 import time
@@ -45,6 +46,10 @@ from rag_llm_k8s_tpu.obs import tracing
 from rag_llm_k8s_tpu.rag.chunking import split_text
 from rag_llm_k8s_tpu.rag.pdf import extract_text
 from rag_llm_k8s_tpu.rag.prompt import assemble_context, assemble_prompt, extract_answer
+from rag_llm_k8s_tpu.resilience import faults
+from rag_llm_k8s_tpu.resilience.admission import AdmissionController, AdmissionRejected
+from rag_llm_k8s_tpu.resilience.breaker import CircuitBreaker
+from rag_llm_k8s_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from rag_llm_k8s_tpu.utils.tokens import truncate_keep_eos
 
 logger = logging.getLogger(__name__)
@@ -124,6 +129,22 @@ class RagService:
         self.metrics = obs_metrics.MetricsRegistry()
         self.traces = tracing.TraceBuffer(128)
         self.started_at = time.monotonic()
+        # resilience layer (ISSUE 4): the readiness breaker over engine
+        # resets, and the bounded admission gate in front of BOTH engine
+        # modes — constructed before observability so the gauges can read
+        # their live state
+        res = config.resilience
+        self.breaker = CircuitBreaker(
+            threshold=res.breaker_reset_threshold, window_s=res.breaker_window_s
+        )
+        self.admission = AdmissionController(
+            max_concurrency=res.admission_max_concurrency,
+            max_queue=res.admission_max_queue,
+            retry_after_s=res.admission_retry_after_s,
+            breaker=self.breaker,
+        )
+        if scheduler is not None and hasattr(scheduler, "breaker"):
+            scheduler.breaker = self.breaker  # resets feed readiness
         self._init_observability()
         self.ready = False
         # per-stage in-flight counters, fed to the coalescers as
@@ -161,6 +182,7 @@ class RagService:
             self.retrieve_coalescer.wait_histogram = (
                 self._m_coalesce_wait.labels(stage="retrieve")
             )
+            self.retrieve_coalescer.join_timeout_counter = self._m_join_timeouts
             if getattr(scheduler, "pending_hint", False) is None:
                 # the generate scheduler is constructed by the caller; give
                 # it the same early-exit hint unless the caller set its own
@@ -263,6 +285,60 @@ class RagService:
             "rag_http_requests_total",
             "served requests by route and status code",
         )
+        # resilience accounting (ISSUE 4) — registered here for EVERY
+        # serving mode so dashboards stay uniform; the continuous scheduler
+        # rebinds onto the same families below and feeds the decode-side
+        # children (stage="decode"/"queue", the reset/retry counters)
+        self._m_adm_rejected = reg.labeled_counter(
+            "rag_admission_rejected_total",
+            "requests shed at the admission gate (reason: queue_full | "
+            "breaker_open)",
+        )
+        for r in ("queue_full", "breaker_open"):
+            self._m_adm_rejected.labels(reason=r)
+        self.admission.reject_counter = self._m_adm_rejected
+        self._m_deadline = reg.labeled_counter(
+            "rag_deadline_exceeded_total",
+            "requests failed by their end-to-end deadline (stage label)",
+        )
+        for s in ("queue", "retrieve", "assemble", "generate", "decode"):
+            self._m_deadline.labels(stage=s)
+        self.admission.deadline_counter = self._m_deadline
+        self._m_degraded = reg.labeled_counter(
+            "rag_degraded_responses_total",
+            "answers served through a quality-degrading fallback (reason: "
+            "prefix_cache | sidecar)",
+        )
+        for r in ("prefix_cache", "sidecar"):
+            self._m_degraded.labels(reason=r)
+        reg.counter(
+            "rag_engine_resets_total",
+            "engine state resets (EngineStateLost / failed decode steps)",
+        )
+        retries_fam = reg.labeled_counter(
+            "rag_inflight_retries_total",
+            "in-flight requests resubmitted after an engine reset "
+            "(outcome: resubmitted | succeeded | gave_up)",
+        )
+        # children exist in every mode so the JSON snapshot and the text
+        # exposition stay name-equivalent (tests/test_obs.py pins it)
+        for o in ("resubmitted", "succeeded", "gave_up"):
+            retries_fam.labels(outcome=o)
+        join_counter = reg.counter(
+            "rag_scheduler_join_timeouts_total",
+            "scheduler shutdowns whose worker thread outlived join(timeout)",
+        )
+        reg.gauge(
+            "rag_breaker_open",
+            "1 while the engine-reset circuit breaker holds readiness at "
+            "503 (Kubernetes is draining this pod)",
+            fn=lambda: float(self.breaker.open),
+        )
+        reg.gauge(
+            "rag_breaker_recent_resets",
+            "engine resets inside the breaker window right now",
+            fn=lambda: float(self.breaker.recent_resets()),
+        )
         # per-device HBM + prefix-cache residency (obs/devices.py): the
         # dashboard view of an eviction storm under HBM pressure
         obs_devices.register_device_gauges(reg, self._prefix_bytes_by_device)
@@ -270,6 +346,13 @@ class RagService:
             bind = getattr(e, "bind_metrics", None)
             if bind is not None:
                 bind(reg)
+        self._m_join_timeouts = join_counter  # shared by every worker shutdown
+        if self.scheduler is not None:
+            sched_bind = getattr(self.scheduler, "bind_metrics", None)
+            if sched_bind is not None:  # continuous: resets/retries/deadline
+                sched_bind(reg)
+            if hasattr(self.scheduler, "join_timeout_counter"):
+                self.scheduler.join_timeout_counter = join_counter
         if self.scheduler is not None and hasattr(self.scheduler, "wait_histogram"):
             self.scheduler.wait_histogram = (
                 self._m_coalesce_wait.labels(stage="generate")
@@ -336,8 +419,12 @@ class RagService:
         return float(self._inflight_generate)
 
     def _queue_depth(self) -> float:
+        """Requests waiting toward the device: the admission gate's bounded
+        line PLUS the scheduler queue behind it — together, the pressure the
+        429 threshold acts on."""
         q = getattr(self.scheduler, "_queue", None)
-        return float(q.qsize()) if q is not None else 0.0
+        depth = float(q.qsize()) if q is not None else 0.0
+        return depth + float(self.admission.queue_depth())
 
     def _observe_request(self, timings: Dict[str, float]) -> None:
         """Feed the request/stage histograms from one answered query's
@@ -633,8 +720,32 @@ class RagService:
         tr.add_span("embed_knn", t0 + tok_s, knn_s, parent=pidx)
 
     # -- query ----------------------------------------------------------
-    def answer(self, user_prompt: str) -> Dict:
+    def _deadline_check(self, dl: Optional[Deadline], stage: str) -> None:
+        """One stage-boundary deadline check: count + raise on expiry."""
+        if dl is not None and dl.expired():
+            self._m_deadline.labels(stage=stage).inc()
+            raise DeadlineExceeded(stage, dl.budget_ms)
+
+    def _degrade(self, notes: List[str], reason: str) -> None:
+        """Record one quality-degrading fallback (satellite: the broad
+        except guards used to swallow these silently)."""
+        self._m_degraded.labels(reason=reason).inc()
+        if reason not in notes:
+            notes.append(reason)
+
+    @staticmethod
+    def _finish(resp: Dict, notes: List[str]) -> Dict:
+        """Stamp degraded-mode markers onto an outgoing response."""
+        if notes:
+            resp["degraded"] = True
+            resp["degraded_reasons"] = list(notes)
+        return resp
+
+    def answer(
+        self, user_prompt: str, deadline: Optional[Deadline] = None
+    ) -> Dict:
         timings: Dict[str, float] = {}
+        notes: List[str] = []  # degraded-path reasons (response + counter)
         t_all = time.monotonic()
         with self._inflight_lock:
             self._inflight_retrieve += 1
@@ -651,12 +762,26 @@ class RagService:
                 # device work happens on the coalescer worker and its
                 # interior split re-attaches via _trace_retrieve below
                 if self.retrieve_coalescer is not None:
-                    r = self.retrieve_coalescer.submit(user_prompt)
+                    # deadline-bounded: a wedged coalescer worker must not
+                    # pin this thread (and its admission slot) forever
+                    try:
+                        r = self.retrieve_coalescer.submit(
+                            user_prompt,
+                            timeout=deadline.wait_timeout()
+                            if deadline is not None else None,
+                        )
+                    except TimeoutError:
+                        self._m_deadline.labels(stage="retrieve").inc()
+                        raise DeadlineExceeded(
+                            "retrieve",
+                            deadline.budget_ms if deadline else None,
+                        ) from None
                 else:
                     r = self._retrieve(user_prompt)
             with self._inflight_lock:
                 self._inflight_retrieve -= 1
             in_retrieve = False
+            self._deadline_check(deadline, "retrieve")
 
             fused_r = (
                 r if isinstance(r, tuple) and len(r) == 4 and r[0] == "__device__"
@@ -677,9 +802,11 @@ class RagService:
                 with self._inflight_lock:
                     self._inflight_generate -= 1
                 in_generate = False
-                resp = self._answer_fused(user_prompt, fused_r, timings, t_all)
+                resp = self._answer_fused(
+                    user_prompt, fused_r, timings, t_all, notes, deadline
+                )
                 if resp is not None:
-                    return resp
+                    return self._finish(resp, notes)
                 with self._inflight_lock:
                     self._inflight_generate += 1
                 in_generate = True
@@ -700,7 +827,10 @@ class RagService:
                 self._trace_retrieve(retrieve_span, t0, timings)
 
             if not results:
-                return {"generated_text": "No relevant information found in the index."}
+                return self._finish(
+                    {"generated_text": "No relevant information found in the index."},
+                    notes,
+                )
 
             with self._inflight_lock:
                 # this request holds one generate claim; more means a burst
@@ -717,9 +847,11 @@ class RagService:
                 with self._inflight_lock:
                     self._inflight_generate -= 1
                 in_generate = False
-                resp = self._answer_prefixed(user_prompt, results, timings, t_all)
+                resp = self._answer_prefixed(
+                    user_prompt, results, timings, t_all, notes
+                )
                 if resp is not None:
-                    return resp
+                    return self._finish(resp, notes)
                 with self._inflight_lock:
                     self._inflight_generate += 1
                 in_generate = True
@@ -735,11 +867,29 @@ class RagService:
                 else:
                     context, prompt_ids = self._budgeted_prompt(user_prompt, results)
             timings["_assemble_s"] = time.monotonic() - t_as
+            self._deadline_check(deadline, "assemble")
 
             t0 = time.monotonic()
             with tracing.span("generate"):
                 if self.scheduler is not None and len(prompt_ids) <= self._scheduler_prompt_cap():
-                    out_ids = self.scheduler.submit(prompt_ids)
+                    try:
+                        out_ids = self.scheduler.submit(
+                            prompt_ids, deadline=deadline
+                        )
+                    except DeadlineExceeded as e:
+                        # worker-side expiries (queue wait, mid-decode
+                        # eviction) were counted where they were raised;
+                        # the caller-side "generate" expiry counts here
+                        if e.stage == "generate":
+                            self._m_deadline.labels(stage="generate").inc()
+                        raise
+                    except TimeoutError:
+                        if deadline is not None and deadline.expired():
+                            self._m_deadline.labels(stage="generate").inc()
+                            raise DeadlineExceeded(
+                                "generate", deadline.budget_ms
+                            ) from None
+                        raise
                 else:
                     # prompts beyond the scheduler's capability need chunked
                     # prefill, which fixed-length continuous slots cannot do —
@@ -774,11 +924,11 @@ class RagService:
         self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
         self.metrics.inc("query_decode_tokens", len(out_ids))
         self._observe_request(timings)
-        return {
+        return self._finish({
             "generated_text": extract_answer(completion),
             "context": context,
             "timings": {k: round(v, 2) for k, v in timings.items()},
-        }
+        }, notes)
 
     def _prefix_enabled(self) -> bool:
         """KV prefix cache applicability (engine/prefix_cache.py)."""
@@ -816,7 +966,8 @@ class RagService:
         except Exception:  # noqa: BLE001 — warmup must not fail boot/ingest
             logger.exception("prefix segment warmup failed")
 
-    def _answer_prefixed(self, user_prompt: str, results, timings, t_all):
+    def _answer_prefixed(self, user_prompt: str, results, timings, t_all,
+                         notes: Optional[List[str]] = None):
         """The KV-prefix-cache tail of ``answer()``: resolve the canonical
         segments against the device-resident cache (misses build + populate
         as they go), splice the matched prefix into a fresh request cache
@@ -843,6 +994,12 @@ class RagService:
                 cp = cache.prefix_for(segments)
             except Exception:  # noqa: BLE001 — cache trouble must not 500 the query
                 logger.exception("prefix-cache resolve failed; host fallback")
+                # the fallback serves a correct answer WITHOUT the cached
+                # KV: mark the response degraded so the quality/latency
+                # loss is visible instead of silent (satellite: the broad
+                # guard used to swallow this entirely)
+                if notes is not None:
+                    self._degrade(notes, "prefix_cache")
                 return None
         if cp is None:
             return None
@@ -874,7 +1031,9 @@ class RagService:
             "timings": {k: round(v, 2) for k, v in timings.items()},
         }
 
-    def _answer_fused(self, user_prompt: str, fused_r, timings, t_all):
+    def _answer_fused(self, user_prompt: str, fused_r, timings, t_all,
+                      notes: Optional[List[str]] = None,
+                      deadline: Optional[Deadline] = None):
         """The single-fetch tail of ``answer()``: device-side prompt assembly
         + generate from the unfetched retrieve handle (engine.generate_rag),
         with the ids fetch for the response's context text overlapped with
@@ -908,6 +1067,10 @@ class RagService:
             snap = self.store.token_snapshot(blocking=False)
         except Exception:  # noqa: BLE001 — sidecar failure must not 500 the query
             logger.exception("chunk-token sidecar unavailable; host fallback")
+            # a broken sidecar (vs a merely in-progress build, the `snap is
+            # None` case below) is a real degradation: say so
+            if notes is not None:
+                self._degrade(notes, "sidecar")
             return None
         if snap is None:
             return None
@@ -935,7 +1098,15 @@ class RagService:
             completion = self.llm_tokenizer.decode(out_ids)
         timings["_detokenize_s"] = time.monotonic() - t_de
         timings["generate_ms"] = (time.monotonic() - t0) * 1e3
-        th.join(timeout=120)
+        # bound the ids-fetch join by the request's remaining deadline
+        # budget (was a hardcoded 120 s — the serving path's only timeout);
+        # floored at 1 s so a deadline spent during generate still gives
+        # the nearly-always-finished fetch one beat to land
+        join_t = (
+            max(1.0, deadline.remaining()) if deadline is not None
+            else self.config.resilience.deadline_ms / 1e3
+        )
+        th.join(timeout=join_t)
         if "packed" not in box:
             err = box.get("err")
             raise err if isinstance(err, BaseException) else RuntimeError(
@@ -1245,6 +1416,8 @@ class WsgiApp:
                 Rule("/slo", endpoint="slo", methods=["GET"]),
                 Rule("/profile", endpoint="profile", methods=["POST"]),
                 Rule("/debug/traces", endpoint="debug_traces", methods=["GET"]),
+                Rule("/debug/faults", endpoint="debug_faults",
+                     methods=["GET", "POST"]),
             ]
         )
         # background xprof capture state (/profile {"seconds": N})
@@ -1256,6 +1429,28 @@ class WsgiApp:
         return self._Response(
             self._json.dumps(payload), status=status, mimetype="application/json"
         )
+
+    def _request_deadline(self, data, headers):
+        """Resolve one request's end-to-end deadline: body ``deadline_ms``
+        wins, then the ``x-request-deadline-ms`` header, then the config
+        default. Returns ``(Deadline, None)`` or ``(None, error_message)``
+        for a malformed value (the route answers 400 — a client that ASKED
+        for a budget must not silently get the default)."""
+        raw = data.get("deadline_ms") if isinstance(data, dict) else None
+        if raw is None:
+            raw = headers.get("x-request-deadline-ms")
+        if raw is None:
+            ms = float(self.service.config.resilience.deadline_ms)
+        else:
+            try:
+                ms = float(raw)
+            except (TypeError, ValueError):
+                return None, f"deadline_ms={raw!r} is not a number"
+            # non-finite values pass the <= 0 check but poison every wait
+            # downstream (inf overflows Event.wait; nan never compares)
+            if not math.isfinite(ms) or ms <= 0:
+                return None, f"deadline_ms={ms:g}: expected a finite value > 0"
+        return Deadline(ms), None
 
     # -- endpoints ------------------------------------------------------
     def ep_upload_pdf(self, request):
@@ -1299,31 +1494,58 @@ class WsgiApp:
             user_prompt = data.get("prompt", "")
             logger.debug("User query: %s", user_prompt)
             tr.attrs["prompt"] = user_prompt[:80]
-            body = self.service.answer(user_prompt)
-            # access line while the trace is still current (formatter
-            # stamps trace_id/span_id from the contextvar)
-            access_logger.info(
-                "request served", extra={
-                    "route": route, "status": 200,
-                    "duration_ms": round((time.monotonic() - t0) * 1e3, 2),
+            deadline, dl_err = self._request_deadline(data, request.headers)
+            if dl_err is not None:
+                status = 400
+                resp = self._jsonify({"error": dl_err}, 400)
+            else:
+                # the admission gate fronts the WHOLE pipeline (both engine
+                # modes): over-cap traffic sheds here in microseconds with
+                # 429/503 + Retry-After instead of queueing unboundedly
+                with self.service.admission.admit(deadline=deadline):
+                    body = self.service.answer(user_prompt, deadline=deadline)
+                # access line while the trace is still current (formatter
+                # stamps trace_id/span_id from the contextvar)
+                access_logger.info(
+                    "request served", extra={
+                        "route": route, "status": 200,
+                        "duration_ms": round((time.monotonic() - t0) * 1e3, 2),
+                    },
+                )
+                tree = tracing.finish_trace(tr, self.service.traces)
+                tr = None
+                if data.get("trace"):
+                    body = dict(body)
+                    body["trace"] = tree
+                resp = self._jsonify(body)
+        except AdmissionRejected as e:
+            status = e.status  # 429 = retry this pod; 503 = breaker/draining
+            resp = self._jsonify(
+                {
+                    "error": "server overloaded" if e.status == 429
+                    else "server draining",
+                    "reason": e.reason,
+                    "retry_after_s": round(e.retry_after_s, 3),
                 },
+                e.status,
             )
-            tree = tracing.finish_trace(tr, self.service.traces)
-            tr = None
-            if data.get("trace"):
-                body = dict(body)
-                body["trace"] = tree
-            resp = self._jsonify(body)
+            resp.headers["Retry-After"] = str(max(1, int(e.retry_after_s + 0.5)))
+        except DeadlineExceeded as e:
+            status = 504
+            resp = self._jsonify(
+                {"error": str(e), "stage": e.stage}, 504
+            )
         except Exception as e:  # noqa: BLE001 — parity with rag.py:179-181
             status = 500
             logger.exception("generate failed")
             resp = self._jsonify({"error": str(e)}, 500)
         finally:
-            if tr is not None:  # error path: keep the partial trace visible
+            if tr is not None:  # non-200 path: keep the partial trace visible
                 tr.attrs["error"] = True
+                tr.attrs["status"] = status
                 access_logger.info(
                     "request failed", extra={
-                        "route": route, "status": 500,
+                        "route": route, "status": status,
                         "duration_ms": round((time.monotonic() - t0) * 1e3, 2),
                     },
                 )
@@ -1343,7 +1565,12 @@ class WsgiApp:
 
     def ep_healthz(self, request):
         svc = self.service
-        ready = svc.ready
+        # the reset breaker gates READINESS only: an open breaker means the
+        # device is resetting faster than it can serve — Kubernetes should
+        # drain the pod (503 here) but NOT restart it (?live=1 stays 200;
+        # a restart would replay warmup into the same sick device)
+        breaker_open = svc.breaker.open
+        ready = svc.ready and not breaker_open
         live = bool(request.args.get("live"))
         body = {
             # ?live=1 is the LIVENESS form (deploy.yaml): 200 whenever the
@@ -1351,7 +1578,7 @@ class WsgiApp:
             # re-warming after an engine reset) must be not-ready, not dead,
             # or the kubelet would restart it into the same warmup
             "status": ("alive" if live else "ok") if (ready or live)
-            else "warming",
+            else ("draining" if breaker_open and svc.ready else "warming"),
             # fleet-dashboard segmentation fields (ISSUE 2 satellite)
             "uptime_s": round(time.monotonic() - svc.started_at, 1),
             "version": _package_version(),
@@ -1367,6 +1594,8 @@ class WsgiApp:
             body["device_platform"] = "unknown"
             body["device_count"] = 0
         body["ready"] = ready
+        body["breaker_open"] = breaker_open
+        body["breaker_recent_resets"] = svc.breaker.recent_resets()
         return self._jsonify(body, 200 if (ready or live) else 503)
 
     def ep_metrics(self, request):
@@ -1404,6 +1633,39 @@ class WsgiApp:
         try:
             limit = request.args.get("limit", type=int)
             return self._jsonify({"traces": self.service.traces.list(limit)})
+        except Exception as e:  # noqa: BLE001
+            return self._jsonify({"error": str(e)}, 500)
+
+    def ep_debug_faults(self, request):
+        """Fault-injection control (resilience/faults.py) — enabled ONLY
+        when the process started with ``TPU_RAG_FAULTS`` in its environment
+        (a production pod is not remotely fault-armable by default).
+
+        GET returns the armed state; POST ``{"site": s, "times": n}`` arms
+        one site, POST ``{"clear": true}`` disarms everything.
+        """
+        if not faults.endpoint_enabled():
+            return self._jsonify(
+                {"error": "fault injection disabled (set TPU_RAG_FAULTS)"}, 403
+            )
+        try:
+            if request.method == "POST":
+                data = request.get_json(force=True, silent=True) or {}
+                if data.get("clear"):
+                    faults.clear()
+                elif "site" in data:
+                    faults.arm(str(data["site"]), int(data.get("times", 1)))
+                else:
+                    return self._jsonify(
+                        {"error": "expected {'site': ..., 'times': N} or "
+                                  "{'clear': true}"}, 400
+                    )
+            return self._jsonify(
+                {"enabled": True, "armed": faults.armed(),
+                 "sites": list(faults.SITES)}
+            )
+        except (TypeError, ValueError) as e:  # unknown site / bad count
+            return self._jsonify({"error": str(e)}, 400)
         except Exception as e:  # noqa: BLE001
             return self._jsonify({"error": str(e)}, 500)
 

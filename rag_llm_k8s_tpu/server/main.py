@@ -163,7 +163,11 @@ def build_service():
             model_cfg, engine.params, sampling=config.sampling,
             engine_config=config.engine, dtypes=config.dtypes, mesh=mesh,
         )
-        scheduler = ContinuousScheduler(cont)
+        scheduler = ContinuousScheduler(
+            cont,
+            retries=config.resilience.inflight_retries,
+            retry_backoff_s=config.resilience.retry_backoff_ms / 1e3,
+        )
     else:
         from rag_llm_k8s_tpu.engine.batching import BatchScheduler
 
@@ -177,6 +181,7 @@ def build_service():
 
 
 def main():
+    from rag_llm_k8s_tpu.resilience import faults
     from rag_llm_k8s_tpu.server.app import create_app
 
     service = build_service()
@@ -187,6 +192,16 @@ def main():
     # warm in the background so /healthz can report progress immediately
     threading.Thread(target=service.warmup, daemon=True).start()
 
+    # chaos/staging only: TPU_RAG_FAULTS arms named failure sites and
+    # enables POST /debug/faults (no-op when the variable is absent).
+    # Armed AFTER boot ingest so the budget tests the SERVING path — arming
+    # earlier let ingest consume e.g. an embed:1 budget and silently drop a
+    # document instead. (Background warmup can still traverse a site; arm
+    # via the endpoint once ready for a fully quiescent start.)
+    armed = faults.arm_from_env()
+    if armed:
+        logger.warning("fault injection armed from TPU_RAG_FAULTS: %s", armed)
+
     app = create_app(service)
     cfg = service.config.server
     logger.info("serving on %s:%d", cfg.host, cfg.port)
@@ -194,6 +209,14 @@ def main():
         "observability: /metrics (Prometheus exposition), /slo (error "
         "budgets + burn rates), /debug/traces (span-tree ring), /profile "
         "{\"seconds\": N} (background xprof) — see docs/OBSERVABILITY.md"
+    )
+    res = service.config.resilience
+    logger.info(
+        "resilience: admission %d concurrent + %d queued (429 beyond), "
+        "default deadline %d ms, breaker %d resets / %.0f s — see "
+        "docs/RESILIENCE.md",
+        res.admission_max_concurrency, res.admission_max_queue,
+        res.deadline_ms, res.breaker_reset_threshold, res.breaker_window_s,
     )
     app.run(host=cfg.host, port=cfg.port)
 

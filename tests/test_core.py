@@ -115,6 +115,42 @@ class TestReferenceParityDefaults:
         with pytest.raises(ValueError):
             AppConfig.from_env({"TPU_RAG_SYNC_STEPS": "0"})
 
+    def test_from_env_resilience(self):
+        c = AppConfig.from_env({
+            "TPU_RAG_ADMISSION_MAX_CONCURRENCY": "4",
+            "TPU_RAG_ADMISSION_MAX_QUEUE": "0",
+            "TPU_RAG_ADMISSION_RETRY_AFTER_S": "2.5",
+            "TPU_RAG_DEADLINE_MS": "30000",
+            "TPU_RAG_BREAKER_RESETS": "5",
+            "TPU_RAG_BREAKER_WINDOW_S": "60",
+            "TPU_RAG_INFLIGHT_RETRIES": "2",
+            "TPU_RAG_RETRY_BACKOFF_MS": "10",
+        })
+        r = c.resilience
+        assert r.admission_max_concurrency == 4
+        assert r.admission_max_queue == 0
+        assert r.admission_retry_after_s == 2.5
+        assert r.deadline_ms == 30000
+        assert r.breaker_reset_threshold == 5
+        assert r.breaker_window_s == 60.0
+        assert r.inflight_retries == 2
+        assert r.retry_backoff_ms == 10.0
+        # defaults survive an empty env
+        d = AppConfig.from_env({}).resilience
+        assert d.deadline_ms == 120_000 and d.inflight_retries == 1
+
+    def test_from_env_resilience_validation(self):
+        for bad in (
+            {"TPU_RAG_ADMISSION_MAX_CONCURRENCY": "0"},
+            {"TPU_RAG_ADMISSION_MAX_QUEUE": "-1"},
+            {"TPU_RAG_DEADLINE_MS": "0"},
+            {"TPU_RAG_BREAKER_RESETS": "0"},
+            {"TPU_RAG_BREAKER_WINDOW_S": "0"},
+            {"TPU_RAG_INFLIGHT_RETRIES": "-1"},
+        ):
+            with pytest.raises(ValueError):
+                AppConfig.from_env(bad)
+
 
 class TestMesh:
     def test_resolved_auto_tp(self):

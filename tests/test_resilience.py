@@ -1,0 +1,618 @@
+"""Resilience layer (ISSUE 4): admission control + load shedding, end-to-end
+deadlines with mid-decode slot eviction, EngineStateLost recovery behind a
+circuit breaker, and the fault-injection harness that makes all of it
+provable on CPU. ``make chaos`` runs this file with ``TPU_RAG_FAULTS``
+armed; it also runs inside the ordinary tier-1 gate (arming there is
+programmatic, so no env is needed)."""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from rag_llm_k8s_tpu.core.config import (
+    AppConfig,
+    DTypePolicy,
+    EncoderConfig,
+    EngineConfig,
+    LlamaConfig,
+    ResilienceConfig,
+    SamplingConfig,
+)
+from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine, ContinuousScheduler
+from rag_llm_k8s_tpu.engine.encoder import EncoderRunner
+from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+from rag_llm_k8s_tpu.index.store import VectorStore
+from rag_llm_k8s_tpu.models.bge_m3 import init_encoder_params
+from rag_llm_k8s_tpu.models.llama import init_llama_params
+from rag_llm_k8s_tpu.obs import metrics as obs_metrics
+from rag_llm_k8s_tpu.resilience import faults
+from rag_llm_k8s_tpu.resilience.admission import AdmissionController, AdmissionRejected
+from rag_llm_k8s_tpu.resilience.breaker import CircuitBreaker
+from rag_llm_k8s_tpu.resilience.deadline import Deadline, DeadlineExceeded
+from rag_llm_k8s_tpu.server.app import RagService, create_app
+
+FP32 = DTypePolicy.fp32()
+GREEDY = SamplingConfig(do_sample=False, max_new_tokens=8)
+ENG_CFG = EngineConfig(prompt_buckets=(16, 32), max_batch_size=4, max_seq_len=64)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    params = init_llama_params(jax.random.PRNGKey(0), cfg, FP32)
+    oracle = InferenceEngine(
+        cfg, params, sampling=GREEDY, engine_config=ENG_CFG, dtypes=FP32
+    )
+    return cfg, params, oracle
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# fault harness
+# ---------------------------------------------------------------------------
+class TestFaults:
+    def test_count_based_arming_fires_exactly_n_times(self):
+        faults.arm("embed", times=2)
+        for _ in range(2):
+            with pytest.raises(faults.InjectedFault) as ei:
+                faults.maybe_fail("embed")
+            assert ei.value.site == "embed"
+        faults.maybe_fail("embed")  # disarmed: no-op
+        assert faults.armed() == {}
+
+    def test_unknown_site_is_loud(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            faults.arm("definitely_not_a_site")
+        with pytest.raises(ValueError, match="expected >= 1"):
+            faults.arm("embed", times=0)
+
+    def test_arm_from_env(self):
+        armed = faults.arm_from_env({"TPU_RAG_FAULTS": "decode_step:2, embed"})
+        assert armed == {"decode_step": 2, "embed": 1}
+        faults.clear()
+        # enable-only forms arm nothing
+        assert faults.arm_from_env({"TPU_RAG_FAULTS": "1"}) == {}
+        assert faults.arm_from_env({}) == {}
+        with pytest.raises(ValueError, match="unknown fault site"):
+            faults.arm_from_env({"TPU_RAG_FAULTS": "tpyo:1"})
+
+    def test_endpoint_enabled_tracks_env_presence(self):
+        assert faults.endpoint_enabled({"TPU_RAG_FAULTS": ""})
+        assert not faults.endpoint_enabled({})
+
+
+# ---------------------------------------------------------------------------
+# deadline
+# ---------------------------------------------------------------------------
+class TestDeadline:
+    def test_expiry_and_check(self):
+        clk = FakeClock()
+        dl = Deadline(100.0, clock=clk)
+        assert not dl.expired()
+        assert dl.remaining() == pytest.approx(0.1)
+        dl.check("retrieve")  # fine
+        clk.advance(0.2)
+        assert dl.expired()
+        with pytest.raises(DeadlineExceeded) as ei:
+            dl.check("assemble")
+        assert ei.value.stage == "assemble"
+        assert dl.wait_timeout() > 0  # floored, never a negative wait
+
+    def test_invalid_budget(self):
+        with pytest.raises(ValueError):
+            Deadline(0)
+
+
+# ---------------------------------------------------------------------------
+# breaker
+# ---------------------------------------------------------------------------
+class TestBreaker:
+    def test_opens_at_threshold_and_self_heals(self):
+        clk = FakeClock()
+        b = CircuitBreaker(threshold=3, window_s=100.0, clock=clk)
+        b.record_reset()  # t=0
+        clk.advance(10.0)
+        b.record_reset()  # t=10
+        assert not b.open
+        assert b.retry_after_s() == 0.0
+        clk.advance(10.0)
+        b.record_reset()  # t=20: third inside the window -> open
+        assert b.open
+        assert b.recent_resets() == 3
+        # Retry-After counts down to the FIRST reset aging out (t=100)
+        assert b.retry_after_s() == pytest.approx(80.0)
+        clk.advance(60.0)
+        assert b.retry_after_s() == pytest.approx(20.0)
+        clk.advance(21.0)  # t=101: the t=0 reset left the window
+        assert not b.open
+        assert b.recent_resets() == 2
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            CircuitBreaker(threshold=0)
+        with pytest.raises(ValueError):
+            CircuitBreaker(window_s=0)
+
+
+# ---------------------------------------------------------------------------
+# admission gate
+# ---------------------------------------------------------------------------
+class TestAdmission:
+    def test_queue_cap_rejection_under_concurrent_submits(self):
+        gate = AdmissionController(max_concurrency=2, max_queue=3)
+        reg = obs_metrics.MetricsRegistry()
+        gate.reject_counter = reg.labeled_counter("rag_admission_rejected_total")
+        hold = threading.Event()
+        outcomes = []
+        lock = threading.Lock()
+
+        def run():
+            try:
+                with gate.admit():
+                    hold.wait(timeout=30)
+                with lock:
+                    outcomes.append("served")
+            except AdmissionRejected as e:
+                with lock:
+                    outcomes.append(e.reason)
+
+        threads = [threading.Thread(target=run) for _ in range(10)]
+        for t in threads:
+            t.start()
+        # settle: 2 active + 3 waiting; the other 5 shed immediately
+        for _ in range(200):
+            with lock:
+                shed = len([o for o in outcomes if o == "queue_full"])
+            if gate.active == 2 and gate.waiting == 3 and shed == 5:
+                break
+            time.sleep(0.01)
+        assert gate.active == 2 and gate.queue_depth() == 3
+        hold.set()
+        for t in threads:
+            t.join(timeout=30)
+        with lock:
+            assert sorted(outcomes) == ["queue_full"] * 5 + ["served"] * 5
+        child = gate.reject_counter.labels(reason="queue_full")
+        assert child.value == 5
+        assert gate.active == 0 and gate.waiting == 0
+
+    def test_rejection_contract(self):
+        gate = AdmissionController(max_concurrency=1, max_queue=0,
+                                   retry_after_s=2.5)
+        with gate.admit():
+            with pytest.raises(AdmissionRejected) as ei:
+                with gate.admit():
+                    pass
+        assert ei.value.status == 429
+        assert ei.value.reason == "queue_full"
+        assert ei.value.retry_after_s == 2.5
+        # slot released: admissible again
+        with gate.admit():
+            pass
+
+    def test_breaker_open_sheds_everything_with_503(self):
+        clk = FakeClock()
+        b = CircuitBreaker(threshold=1, window_s=50.0, clock=clk)
+        gate = AdmissionController(max_concurrency=8, max_queue=8, breaker=b)
+        b.record_reset()
+        with pytest.raises(AdmissionRejected) as ei:
+            with gate.admit():
+                pass
+        assert ei.value.status == 503
+        assert ei.value.reason == "breaker_open"
+        assert ei.value.retry_after_s >= 1.0
+        clk.advance(51.0)  # breaker heals -> gate admits again
+        with gate.admit():
+            pass
+
+    def test_deadline_expiry_while_queued(self):
+        gate = AdmissionController(max_concurrency=1, max_queue=4)
+        clk = FakeClock()
+        dl = Deadline(50.0, clock=clk)
+        clk.advance(1.0)  # expired before it ever waits
+        with gate.admit():
+            with pytest.raises(DeadlineExceeded) as ei:
+                with gate.admit(deadline=dl):
+                    pass
+        assert ei.value.stage == "queue"
+
+
+# ---------------------------------------------------------------------------
+# continuous engine: deadline eviction + reset recovery via fault injection
+# ---------------------------------------------------------------------------
+class TestDeadlineEviction:
+    def test_expired_mid_decode_frees_slot_within_a_step(self, tiny):
+        cfg, params, _ = tiny
+        eng = ContinuousEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=2000),
+            engine_config=EngineConfig(
+                prompt_buckets=(16,), max_batch_size=4, max_seq_len=2048
+            ),
+            dtypes=FP32,
+        )
+        sched = ContinuousScheduler(eng)
+        try:
+            with pytest.raises(DeadlineExceeded) as ei:
+                sched.submit([3, 17, 42], deadline=Deadline(300.0))
+            assert ei.value.stage in ("decode", "generate")
+            # the zombie's slot must free within one scheduler iteration —
+            # poll briefly to absorb the step in flight
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if len(eng.free_slots()) == eng.B:
+                    break
+                time.sleep(0.02)
+            assert len(eng.free_slots()) == eng.B, "evicted row still active"
+            # and the scheduler still serves
+            out = sched.submit([5, 5, 8], max_new_tokens=4, timeout=120)
+            assert isinstance(out, list) and out
+        finally:
+            sched.shutdown()
+
+    def test_expired_in_queue_is_never_admitted(self, tiny):
+        cfg, params, _ = tiny
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=ENG_CFG, dtypes=FP32
+        )
+        sched = ContinuousScheduler(eng)
+        try:
+            clk = FakeClock()
+            dl = Deadline(10.0, clock=clk)
+            clk.advance(1.0)  # already expired on arrival
+            before = eng.stats.generate_calls
+            with pytest.raises(DeadlineExceeded) as ei:
+                sched.submit([3, 17, 42], deadline=dl, timeout=30)
+            assert ei.value.stage == "queue"
+            assert eng.stats.generate_calls == before  # no prefill happened
+        finally:
+            sched.shutdown()
+
+
+class TestResetRecovery:
+    def test_insert_fault_recovers_via_resubmit(self, tiny):
+        """An injected EngineStateLost at admission completes the request
+        via resubmission — the caller never sees the fault."""
+        cfg, params, oracle = tiny
+        want = oracle.generate([[3, 17, 42, 7, 99]])[0]
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=ENG_CFG, dtypes=FP32
+        )
+        sched = ContinuousScheduler(eng, retry_backoff_s=0.0)
+        reg = obs_metrics.MetricsRegistry()
+        sched.bind_metrics(reg)
+        try:
+            faults.arm("insert", times=1)
+            out = sched.submit([3, 17, 42, 7, 99], timeout=120)
+            assert out == want
+            assert faults.armed() == {}, "the fault never fired"
+            assert reg.counter("rag_engine_resets_total").value == 1
+            fam = reg.labeled_counter("rag_inflight_retries_total")
+            assert fam.labels(outcome="resubmitted").value == 1
+            assert fam.labels(outcome="succeeded").value == 1
+            assert fam.labels(outcome="gave_up").value == 0
+        finally:
+            sched.shutdown()
+
+    def test_decode_fault_recovers_and_preserves_greedy_stream(self, tiny):
+        cfg, params, oracle = tiny
+        want = oracle.generate([[3, 17, 42, 7, 99]])[0]
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=ENG_CFG, dtypes=FP32
+        )
+        sched = ContinuousScheduler(eng, retry_backoff_s=0.0)
+        try:
+            faults.arm("decode_step", times=1)
+            out = sched.submit([3, 17, 42, 7, 99], timeout=120)
+            assert out == want
+        finally:
+            sched.shutdown()
+
+    def test_recovery_with_prompt_at_largest_bucket_stays_exact(self, tiny):
+        """A prompt already filling the largest bucket cannot resume as
+        prompt+emitted (admit_many would left-truncate the context) — the
+        recovery restarts from scratch instead, which is still exact."""
+        cfg, params, oracle = tiny
+        prompt = [5] * 32  # fills the largest bucket: no room for emitted tokens
+        want = oracle.generate([prompt])[0]
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=ENG_CFG, dtypes=FP32
+        )
+        sched = ContinuousScheduler(eng, retry_backoff_s=0.0)
+        try:
+            faults.arm("decode_step", times=1)
+            out = sched.submit(prompt, timeout=120)
+            assert out == want
+        finally:
+            sched.shutdown()
+
+    def test_second_fault_gives_up_with_the_error(self, tiny):
+        """retries=1 means exactly one recovery: a device that faults on
+        the retry too fails the request (no infinite resubmit loop)."""
+        cfg, params, _ = tiny
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=ENG_CFG, dtypes=FP32
+        )
+        sched = ContinuousScheduler(eng, retry_backoff_s=0.0)
+        reg = obs_metrics.MetricsRegistry()
+        sched.bind_metrics(reg)
+        try:
+            faults.arm("insert", times=2)
+            with pytest.raises(Exception) as ei:
+                sched.submit([3, 17, 42], timeout=120)
+            assert "insert failed" in str(ei.value)
+            fam = reg.labeled_counter("rag_inflight_retries_total")
+            assert fam.labels(outcome="gave_up").value == 1
+            # and the engine still serves afterwards
+            out = sched.submit([5, 5, 8], timeout=120)
+            assert isinstance(out, list) and out
+        finally:
+            sched.shutdown()
+
+    def test_reset_storm_opens_breaker(self, tiny):
+        cfg, params, _ = tiny
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=ENG_CFG, dtypes=FP32
+        )
+        sched = ContinuousScheduler(eng, retry_backoff_s=0.0)
+        breaker = CircuitBreaker(threshold=2, window_s=600.0)
+        sched.breaker = breaker
+        try:
+            for _ in range(2):
+                faults.arm("decode_step", times=1)
+                sched.submit([3, 17, 42], timeout=120)  # recovered each time
+            assert breaker.open
+        finally:
+            sched.shutdown()
+
+
+class TestSchedulerLifecycle:
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+    )
+    def test_submit_after_worker_death_fails_fast(self, tiny):
+        """Satellite: a dead worker must not let submit() enqueue into a
+        queue nobody drains (the caller would block forever)."""
+        cfg, params, _ = tiny
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=ENG_CFG, dtypes=FP32
+        )
+        sched = ContinuousScheduler(eng)
+        try:
+            # kill the worker with an error its loop does not guard
+            eng.free_slots = None  # TypeError on next call
+            try:
+                sched.submit([3, 17, 42], timeout=30)
+            except BaseException:  # noqa: BLE001 — delivery form is not the point
+                pass
+            sched._worker.join(timeout=30)
+            assert not sched._worker.is_alive()
+            # post-mortem submits fail fast instead of blocking forever
+            t0 = time.monotonic()
+            with pytest.raises(RuntimeError, match="shut down"):
+                sched.submit([5, 5], timeout=None)
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# HTTP integration: 429 shape, Retry-After, 504, breaker readiness, degraded
+# ---------------------------------------------------------------------------
+class ByteTokenizer:
+    def encode(self, text):
+        return [b + 3 for b in text.encode("utf-8")]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return bytes((i - 3) % 256 for i in ids if i >= 3).decode("utf-8", "replace")
+
+
+def make_service(resilience=None, prompt_buckets=(128, 256), max_seq_len=4096 + 256):
+    llama_cfg = LlamaConfig.tiny(vocab_size=300)
+    enc_cfg = EncoderConfig.tiny(vocab_size=300)
+    cfg = AppConfig(
+        model=llama_cfg, encoder=enc_cfg,
+        resilience=resilience or ResilienceConfig(),
+    )
+    engine = InferenceEngine(
+        llama_cfg,
+        init_llama_params(jax.random.PRNGKey(0), llama_cfg, FP32),
+        sampling=SamplingConfig(do_sample=False, max_new_tokens=8),
+        engine_config=EngineConfig(
+            prompt_buckets=prompt_buckets, max_batch_size=2,
+            max_seq_len=max_seq_len,
+        ),
+        dtypes=FP32,
+    )
+    encoder = EncoderRunner(
+        enc_cfg,
+        init_encoder_params(jax.random.PRNGKey(1), enc_cfg, FP32),
+        dtypes=FP32, length_buckets=(32, 64), max_batch=4,
+    )
+    store = VectorStore(dim=enc_cfg.hidden_size)
+    svc = RagService(cfg, engine, ByteTokenizer(), encoder, ByteTokenizer(), store)
+    svc.ready = True
+    texts = ["alpha beta gamma", "delta epsilon zeta"]
+    vecs = encoder.encode([ByteTokenizer().encode(t) for t in texts])
+    store.add(list(vecs), [
+        {"filename": "f", "chunk_id": i, "text": t} for i, t in enumerate(texts)
+    ])
+    return svc
+
+
+@pytest.fixture(scope="module")
+def http_service():
+    return make_service()
+
+
+class TestHttpShedding:
+    def test_429_body_shape_and_retry_after_header(self, http_service):
+        svc = http_service
+        client = create_app(svc).test_client()
+        gate = svc.admission
+        old = (gate.max_concurrency, gate.max_queue)
+        gate.max_concurrency, gate.max_queue = 1, 0
+        try:
+            with gate.admit():  # the one slot is taken; queue cap is 0
+                r = client.post("/generate", json={"prompt": "alpha"})
+            assert r.status_code == 429
+            body = r.get_json()
+            assert body["reason"] == "queue_full"
+            assert body["error"] == "server overloaded"
+            assert body["retry_after_s"] == pytest.approx(1.0)
+            assert int(r.headers["Retry-After"]) >= 1
+            # the shed is counted
+            snap = svc.metrics.snapshot()
+            assert snap["rag_admission_rejected_total"] >= 1
+        finally:
+            gate.max_concurrency, gate.max_queue = old
+
+    def test_shed_requests_count_toward_availability_family(self, http_service):
+        svc = http_service
+        fam = svc.metrics.get_family("rag_http_requests_total")
+        before = sum(
+            c.value for labels, c in fam.items() if dict(labels).get("code") == "429"
+        )
+        client = create_app(svc).test_client()
+        gate = svc.admission
+        old = (gate.max_concurrency, gate.max_queue)
+        gate.max_concurrency, gate.max_queue = 1, 0
+        try:
+            with gate.admit():
+                client.post("/generate", json={"prompt": "alpha"})
+        finally:
+            gate.max_concurrency, gate.max_queue = old
+        after = sum(
+            c.value for labels, c in fam.items() if dict(labels).get("code") == "429"
+        )
+        assert after == before + 1
+
+    def test_breaker_open_flips_healthz_readiness_and_sheds_503(self, http_service):
+        svc = http_service
+        client = create_app(svc).test_client()
+        assert client.get("/healthz").status_code == 200
+        for _ in range(svc.breaker.threshold):
+            svc.breaker.record_reset()
+        try:
+            r = client.get("/healthz")
+            assert r.status_code == 503
+            body = r.get_json()
+            assert body["breaker_open"] is True
+            assert body["status"] == "draining"
+            # liveness is NOT affected: draining, not restarting
+            assert client.get("/healthz?live=1").status_code == 200
+            # and /generate sheds with 503 + Retry-After
+            r = client.post("/generate", json={"prompt": "alpha"})
+            assert r.status_code == 503
+            assert r.get_json()["reason"] == "breaker_open"
+            assert "Retry-After" in r.headers
+        finally:
+            svc.breaker._events.clear()
+        assert client.get("/healthz").status_code == 200
+
+    def test_deadline_404_shapes(self, http_service):
+        client = create_app(http_service).test_client()
+        # malformed deadline -> 400, not silently defaulted
+        r = client.post("/generate", json={"prompt": "a", "deadline_ms": "soon"})
+        assert r.status_code == 400
+        r = client.post("/generate", json={"prompt": "a", "deadline_ms": -5})
+        assert r.status_code == 400
+        # non-finite values must be 400, not an OverflowError-500 ("inf")
+        # or a silent never-expiring request ("nan")
+        for bad in ("inf", "nan", "-inf"):
+            r = client.post("/generate", json={"prompt": "a", "deadline_ms": bad})
+            assert r.status_code == 400, (bad, r.get_json())
+        # a microscopic budget -> 504 naming the stage it died at
+        r = client.post("/generate", json={"prompt": "alpha", "deadline_ms": 0.001})
+        assert r.status_code == 504
+        body = r.get_json()
+        assert body["stage"] in ("queue", "retrieve", "assemble", "generate")
+        snap = http_service.metrics.snapshot()
+        assert snap["rag_deadline_exceeded_total"] >= 1
+
+    def test_header_deadline_is_honored(self, http_service):
+        client = create_app(http_service).test_client()
+        r = client.post(
+            "/generate", json={"prompt": "alpha"},
+            headers={"x-request-deadline-ms": "0.001"},
+        )
+        assert r.status_code == 504
+
+    def test_normal_request_unaffected_and_undegraded(self, http_service):
+        client = create_app(http_service).test_client()
+        r = client.post("/generate", json={"prompt": "alpha"})
+        assert r.status_code == 200
+        body = r.get_json()
+        assert "generated_text" in body
+        assert "degraded" not in body
+
+    def test_debug_faults_endpoint_gated_on_env(self, http_service, monkeypatch):
+        client = create_app(http_service).test_client()
+        monkeypatch.delenv("TPU_RAG_FAULTS", raising=False)
+        assert client.get("/debug/faults").status_code == 403
+        monkeypatch.setenv("TPU_RAG_FAULTS", "1")
+        r = client.get("/debug/faults")
+        assert r.status_code == 200
+        assert r.get_json()["armed"] == {}
+        r = client.post("/debug/faults", json={"site": "embed", "times": 3})
+        assert r.status_code == 200
+        assert r.get_json()["armed"] == {"embed": 3}
+        assert client.post(
+            "/debug/faults", json={"site": "nope"}
+        ).status_code == 400
+        r = client.post("/debug/faults", json={"clear": True})
+        assert r.get_json()["armed"] == {}
+
+    def test_store_fault_surfaces_as_500_not_hang(self, http_service):
+        client = create_app(http_service).test_client()
+        faults.arm("store_lookup", times=1)
+        r = client.post("/generate", json={"prompt": "alpha"})
+        assert r.status_code == 500
+        assert "injected fault" in r.get_json()["error"]
+        # disarmed: next request serves
+        assert client.post(
+            "/generate", json={"prompt": "alpha"}
+        ).status_code == 200
+
+
+class TestDegradedMarking:
+    def test_prefix_cache_failure_marks_response_degraded(self):
+        # bucket must fit the byte-tokenized system head + tail with >= 16
+        # tokens of context room, or the prefixed path never engages
+        svc = make_service(prompt_buckets=(128, 1024), max_seq_len=1024 + 128)
+
+        class BrokenCache:
+            def prefix_for(self, segments):
+                raise RuntimeError("cache exploded")
+
+        svc.engine.prefix_cache = BrokenCache()
+        try:
+            client = create_app(svc).test_client()
+            r = client.post("/generate", json={"prompt": "alpha"})
+            assert r.status_code == 200, r.get_json()
+            body = r.get_json()
+            assert body.get("degraded") is True
+            assert body["degraded_reasons"] == ["prefix_cache"]
+            snap = svc.metrics.snapshot()
+            assert snap["rag_degraded_responses_total"] == 1
+        finally:
+            svc.engine.prefix_cache = None
