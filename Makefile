@@ -34,11 +34,13 @@ tpu-test:
 bench:
 	python bench.py
 
-# Chaos lane (ISSUE 4): the fault-injection suite with TPU_RAG_FAULTS armed
-# (enables the harness end-to-end, including the arm_from_env path), proving
-# on CPU that: a queue over cap returns 429 + Retry-After, a deadline expiry
-# mid-decode frees its slot, an injected EngineStateLost completes via
-# resubmit, and a reset storm flips /healthz readiness. docs/RESILIENCE.md.
+# Chaos lane (ISSUE 4 + ISSUE 5): the fault-injection suite with
+# TPU_RAG_FAULTS armed (enables the harness end-to-end, including the
+# arm_from_env path), proving on CPU that: a queue over cap returns 429 +
+# Retry-After, a deadline expiry mid-decode frees its slot, an injected
+# EngineStateLost completes via resubmit (and, on the PAGED engine, returns
+# every KV block to the free list — zero leaks), and a reset storm flips
+# /healthz readiness. docs/RESILIENCE.md, docs/KV_POOL.md.
 chaos:
 	env TPU_RAG_FAULTS=1 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q -p no:cacheprovider
 
@@ -50,6 +52,10 @@ chaos:
 # envelope is unwrapped) and a fresh capture as current:
 #   make bench-gate BENCH_BASELINE=BENCH_r03.json BENCH_CURRENT=/tmp/bench_fresh.json
 # Disjoint schemas (zero shared comparable metrics) exit 2, never "OK".
+# REQUIRED_KEYS in the script (continuous_device_steps_per_s.b64_sync16,
+# tracked higher-is-better) may never silently vanish from a judged run —
+# a dropped leg fails the gate instead of reading as a pass, so the B=64
+# continuous regression can never return unjudged.
 BENCH_BASELINE ?= BENCH_BASELINE.json
 BENCH_CURRENT ?= $(BENCH_BASELINE)
 bench-gate:

@@ -432,6 +432,28 @@ class EngineConfig:
     # matrix stops being worth its HBM (cap × row_len × 4B) and solo queries
     # fall back to the host path. 64k rows × 2k tokens ≈ 512 MB.
     rag_fused_max_vectors: int = 65536
+    # paged KV cache for the CONTINUOUS engine (engine/kv_pool.py +
+    # ops.attention paged kernels): the per-slot dense [B, T] cache becomes
+    # a [num_blocks, block_size] block-pool arena with per-row block
+    # tables — HBM and decode bandwidth scale with REAL tokens per row
+    # instead of the full window (the B=64 occupancy unlock; vLLM /
+    # JetStream design). Off by default: the dense path is untouched.
+    # Env: TPU_RAG_KV_PAGED.
+    kv_paged: bool = False
+    # tokens per physical block. Must be a multiple of the Mosaic
+    # second-to-minor tile for the arena dtype (16 bf16 / 32 int8) and must
+    # divide every prompt bucket. Smaller blocks waste less tail (≤ one
+    # block per row) but grow the tables and the grid; 16 is the bf16 tile
+    # minimum and the measured sweet spot at 1B-8B scale.
+    # Env: TPU_RAG_KV_BLOCK_SIZE.
+    kv_block_size: int = 16
+    # allocatable physical blocks in the pool (the +1 reserved null block
+    # is added internally). 0 = "dense parity": max_batch_size * ceil(T /
+    # block_size) — same worst-case HBM as the dense cache, but shared, so
+    # real mixed-length traffic fits far more rows. Size it DOWN to trade
+    # worst-case capacity for HBM (admission backpressures instead of
+    # crashing when it runs out). Env: TPU_RAG_KV_POOL_BLOCKS.
+    kv_pool_blocks: int = 0
     # cross-request KV prefix cache (see PrefixCacheConfig)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
 
@@ -572,6 +594,25 @@ class AppConfig:
                     f"TPU_RAG_KV_QUANT={kvq!r}: expected 'bf16' or 'int8'"
                 )
             engine = dataclasses.replace(engine, kv_quant=kvq)
+        if "TPU_RAG_KV_PAGED" in env:
+            flag = env["TPU_RAG_KV_PAGED"]
+            if flag not in ("0", "1"):
+                raise ValueError(
+                    f"TPU_RAG_KV_PAGED={flag!r}: expected '0' or '1'"
+                )
+            engine = dataclasses.replace(engine, kv_paged=flag == "1")
+        if "TPU_RAG_KV_BLOCK_SIZE" in env:
+            bs = int(env["TPU_RAG_KV_BLOCK_SIZE"])
+            if bs < 1:
+                raise ValueError(f"TPU_RAG_KV_BLOCK_SIZE={bs}: expected >= 1")
+            engine = dataclasses.replace(engine, kv_block_size=bs)
+        if "TPU_RAG_KV_POOL_BLOCKS" in env:
+            nb = int(env["TPU_RAG_KV_POOL_BLOCKS"])
+            if nb < 0:
+                raise ValueError(
+                    f"TPU_RAG_KV_POOL_BLOCKS={nb}: expected >= 0 (0 = dense parity)"
+                )
+            engine = dataclasses.replace(engine, kv_pool_blocks=nb)
         if "TPU_RAG_WARM_FULL_LADDER" in env:
             flag = env["TPU_RAG_WARM_FULL_LADDER"]
             if flag not in ("0", "1"):

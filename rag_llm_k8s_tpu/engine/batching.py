@@ -250,6 +250,8 @@ class BatchScheduler:
         seed: Optional[int] = None,
         timeout: Optional[float] = None,
         deadline=None,  # Optional[resilience.Deadline]
+        info: Optional[dict] = None,  # accepted for scheduler-API parity;
+        # only the continuous scheduler has per-request engine facts to fill
     ) -> List[int]:
         """Blocking: enqueue and wait for this prompt's continuation.
 

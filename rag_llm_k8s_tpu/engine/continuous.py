@@ -57,8 +57,14 @@ from rag_llm_k8s_tpu.engine.engine import (
     maybe_quantize_params,
     param_avals,
 )
+from rag_llm_k8s_tpu.engine.kv_pool import KVBlockPool, NULL_BLOCK, PoolExhausted
 from rag_llm_k8s_tpu.engine.sampling import sample_token_per_row
-from rag_llm_k8s_tpu.models.llama import LlamaModel, make_kv_cache, mask_window
+from rag_llm_k8s_tpu.models.llama import (
+    LlamaModel,
+    make_kv_arena,
+    make_kv_cache,
+    mask_window,
+)
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
 from rag_llm_k8s_tpu.resilience import faults
 from rag_llm_k8s_tpu.resilience.deadline import Deadline, DeadlineExceeded
@@ -80,6 +86,15 @@ class _Slot:
     tokens: List[int] = field(default_factory=list)
     remaining: int = 0
     active: bool = False
+    # paged mode only: the host mirror of this row's logical frontier (an
+    # UPPER bound — EOS mid-window stops the device early; the mirror only
+    # drives block pre-allocation, over-allocation frees at retire), the
+    # admission sequence (preemption picks the newest victims first), and
+    # the prompt's true token count (resubmission bookkeeping)
+    kv_ub: int = 0
+    admit_seq: int = 0
+    prompt_len: int = 0
+    shared_tokens: int = 0  # tokens served by ref-shared prefix blocks
 
 
 class ContinuousEngine:
@@ -121,6 +136,55 @@ class ContinuousEngine:
                 f"kv_quant={engine_config.kv_quant!r}: expected 'bf16' or 'int8'"
             )
         self.kv_quant = engine_config.kv_quant
+        # ---- paged KV (block-pool arena; EngineConfig.kv_paged) ---------
+        self.paged = bool(getattr(engine_config, "kv_paged", False))
+        self.kv_pool: Optional[KVBlockPool] = None
+        if self.paged:
+            if mesh is not None and mesh.tp > 1:
+                raise ValueError(
+                    "kv_paged does not support tp>1 meshes yet — the arena "
+                    "has no shard_map'd paged kernels; run paged on tp=1 or "
+                    "keep the dense slot cache on multi-chip"
+                )
+            bs = int(engine_config.kv_block_size)
+            min_tile = 32 if self.kv_quant == "int8" else 16
+            if bs < 1 or bs % min_tile:
+                raise ValueError(
+                    f"kv_block_size={bs} must be a positive multiple of the "
+                    f"Mosaic {min_tile}-row tile (kv_quant={self.kv_quant!r})"
+                )
+            bad = [b for b in self.buckets if b % bs]
+            if bad or self.T % bs:
+                raise ValueError(
+                    f"kv_block_size={bs} must divide every prompt bucket "
+                    f"{self.buckets} and the slot length {self.T}"
+                )
+            # max logical blocks any row can hold (tables are [B, MB])
+            self.MB = self.T // bs
+            usable = int(engine_config.kv_pool_blocks) or self.B * self.MB
+            if usable < self.MB:
+                raise ValueError(
+                    f"kv_pool_blocks={usable}: the pool must hold at least "
+                    f"one full row ({self.MB} blocks of {bs})"
+                )
+            self.kv_pool = KVBlockPool(usable + 1, bs)  # +1: the null block
+            self.block_size = bs
+            self._tables_host = np.zeros((self.B, self.MB), np.int32)
+            self._tables_dev = None
+            self._tables_dirty = True
+            self._slot_blocks: List[List[int]] = [[] for _ in range(self.B)]
+            # block-granular prefix reuse: chain_key -> (full block ids,
+            # covered tokens, prefix length); the pool holds one cache ref
+            # per registered block so rows come and go copy-free
+            self._prefix_blocks: "Dict[object, Tuple[List[int], int, int]]" = {}
+            # covered tokens across registrations, maintained at every
+            # register/evict site (all on the scheduler thread): the
+            # fragmentation gauge's scrape-thread callback reads this ONE
+            # int instead of iterating the dict the scheduler mutates
+            self._registered_tokens = 0
+            self._admit_seq = 0
+            self._preempted: List[Tuple[int, List[int]]] = []
+            self._blocks_at_retire: Dict[int, int] = {}
         self.params, fused = maybe_fuse_params(params, engine_config, mesh)
         self.params, quantized = maybe_quantize_params(self.params, engine_config)
         self.model = LlamaModel(
@@ -131,6 +195,10 @@ class ContinuousEngine:
         # chunked variant for prefix-cache admissions: the suffix prefills
         # over a spliced cached-prefix block with offset causality
         self.model_chunked = self.model.copy(chunked=True)
+        if self.paged:
+            # paged variants: same static switches + the block-table arg
+            self.model_step_paged = self.model.copy(row_frontier=True, paged=True)
+            self.model_chunked_paged = self.model.copy(chunked=True, paged=True)
         self._compiled: Dict[Tuple[str, int, int], jax.stages.Compiled] = {}
         # ---- persistent device state -----------------------------------
         # the cache rides as a TUPLE pytree through every executable:
@@ -193,6 +261,36 @@ class ContinuousEngine:
         self._m_step_device = step_fam.labels(phase="device_fetch")
         self._m_step_drain = step_fam.labels(phase="host_drain")
         self._m_step_admit = step_fam.labels(phase="admit")
+        # paged KV pool occupancy (families exist in every mode so scrapes
+        # and dashboards stay uniform; they read 0 under the dense cache)
+        pool = self.kv_pool
+        registry.labeled_gauge(
+            "rag_kv_pool_blocks_total",
+            "allocatable physical KV blocks (paged mode; 0 dense)",
+        ).labels_callback(
+            lambda: float(pool.usable_blocks()) if pool is not None else 0.0
+        )
+        registry.labeled_gauge(
+            "rag_kv_pool_blocks_in_use",
+            "physical KV blocks currently referenced (paged mode)",
+        ).labels_callback(
+            lambda: float(pool.blocks_in_use()) if pool is not None else 0.0
+        )
+        registry.labeled_gauge(
+            "rag_kv_pool_fragmentation",
+            "fraction of allocated KV token slots not holding live KV "
+            "(internal fragmentation — pad/tail waste of the block layout)",
+        ).labels_callback(
+            lambda: (
+                pool.fragmentation(self.pool_used_tokens())
+                if pool is not None else 0.0
+            )
+        )
+        self._m_pool_preempt = registry.counter(
+            "rag_kv_pool_preemptions_total",
+            "rows preempted mid-decode by pool exhaustion (resubmitted by "
+            "the scheduler; callers see latency, not errors)",
+        )
 
     def warmup(self, batch_sizes=None, buckets=None):
         """AOT-compile every executable serving will hit (readiness gating).
@@ -212,9 +310,13 @@ class ContinuousEngine:
             if S not in self.buckets:
                 continue  # admit can never use a bucket without decode room
             for n in sorted(sizes):
-                self._get("prefill", S, n)
-                self._get("insert", S, n)
-        self._get("step", self.sync_steps)
+                if self.paged:
+                    self._get("prefill_paged", S, n)
+                    self._get("insert_paged", S, n)
+                else:
+                    self._get("prefill", S, n)
+                    self._get("insert", S, n)
+        self._get("step_paged" if self.paged else "step", self.sync_steps)
 
     def _put(self, x, sharding=None):
         """Place a host/device value to match a lowered aval's sharding;
@@ -231,10 +333,16 @@ class ContinuousEngine:
         an OOM risk at construction and at every post-failure reset."""
 
         def build():
-            cache = make_kv_cache(
-                self.config, self.B, self.T, self.dtypes.compute_dtype,
-                quant=self.kv_quant,
-            )
+            if self.paged:
+                cache = make_kv_arena(
+                    self.config, self.kv_pool.num_blocks, self.block_size,
+                    self.dtypes.compute_dtype, quant=self.kv_quant,
+                )
+            else:
+                cache = make_kv_cache(
+                    self.config, self.B, self.T, self.dtypes.compute_dtype,
+                    quant=self.kv_quant,
+                )
             if self.kv_quant == "int8":
                 return (cache.k, cache.v, cache.k_scale, cache.v_scale)
             return (cache.k, cache.v)
@@ -256,6 +364,21 @@ class ContinuousEngine:
         self._last_tok = self._put(jnp.zeros((self.B,), jnp.int32))
         self._active = self._put(jnp.zeros((self.B,), bool))
         self._rng_keys = self._put(jnp.zeros((self.B, 2), jnp.uint32))
+        if self.paged:
+            # every block back to the free list: the arena was rebuilt, so a
+            # ref held across reset() would leak the pool one reset at a
+            # time (make chaos asserts zero leaked blocks after recovery)
+            self.kv_pool.reset()
+            self._tables_host[:] = NULL_BLOCK
+            self._tables_dirty = True
+            self._slot_blocks = [[] for _ in range(self.B)]
+            self._prefix_blocks.clear()
+            self._registered_tokens = 0
+            # pending preemption records describe PRE-reset slots; the reset
+            # recovery resubmits every in-flight request itself, so replaying
+            # a stale record would double-submit it (duplicate tokens at the
+            # stream head + a full duplicate decode)
+            self._preempted.clear()
 
     # ------------------------------------------------------------------
     # executables
@@ -267,10 +390,20 @@ class ContinuousEngine:
             t0 = time.perf_counter()
             if kind == "step":
                 fn = self._build_step(S)  # S carries the sync window here
+            elif kind == "step_paged":
+                fn = self._build_step_paged(S)
             elif kind == "prefill":
                 fn = self._build_prefill(S, n)
+            elif kind == "prefill_paged":
+                fn = self._build_prefill_paged(S, n)
+            elif kind == "insert_paged":
+                fn = self._build_insert_paged(S, n)
             elif kind == "prefill_px":
                 fn = self._build_prefill_prefixed(S, n)  # n carries the suffix bucket
+            elif kind == "prefill_px_paged":
+                fn = self._build_prefill_px_paged(S)  # S carries the suffix bucket
+            elif kind == "prefix_scatter":
+                fn = self._build_prefix_scatter(S)  # S carries the buffer width
             else:
                 fn = self._build_insert(S, n)
             self._m_compile_events.inc()
@@ -490,6 +623,11 @@ class ContinuousEngine:
         toks = np.full((1, C), self.pad_id, np.int32)
         toks[0, : len(suffix)] = list(suffix)
         row = free[0]
+        if self.paged:
+            return self._admit_prefixed_paged(
+                request_id, suffix, prefix, C, max_new_c, row, row_key,
+                folded, toks,
+            )
         row_cache, tok0s, row_starts = self._get("prefill_px", S, C)(
             self.params, self._put(toks), self._put(jnp.int32(len(suffix))),
             tuple(self._put(p) for p in prefix.planes),
@@ -520,6 +658,105 @@ class ContinuousEngine:
         self.slots[row] = _Slot(
             request_id=request_id, tokens=[tok0], remaining=max_new_c - 1,
             active=True,
+        )
+        self.stats.decode_tokens += 1
+        return row, None
+
+    def _admit_prefixed_paged(
+        self, request_id, suffix, prefix, C, max_new_c, row, row_key,
+        folded, toks,
+    ):
+        """Paged tail of ``admit_prefixed``: block-granular prefix reuse.
+
+        Shared FULL blocks of a previously-seen prefix (keyed by the
+        descriptor's ``chain_key`` — set only under exact-chain reuse, the
+        policy whose cached KV is bit-faithful to a cold prefill) map into
+        the row's table copy-free, pinned by a pool ref; only the partial
+        tail block scatters from the descriptor's splice buffer, and only
+        the suffix prefills — a paged chunk straight into pool blocks. A
+        first sighting scatters the whole prefix and REGISTERS its full
+        blocks (one cache ref each), so the next request with the same
+        prompt head shares them without copying a byte."""
+        bs = self.block_size
+        plen = int(prefix.length)
+        slen = len(suffix)
+        total = plen + slen
+        P = int(prefix.capacity)
+        if P % bs:
+            raise ValueError(
+                f"prefix capacity {P} not a multiple of kv_block_size {bs}"
+            )
+        key = getattr(prefix, "chain_key", None)
+        shared_ids: List[int] = []
+        if key is not None:
+            entry = self._prefix_blocks.get(key)
+            if entry is not None and entry[2] == plen:
+                shared_ids = list(entry[0])
+        covered = len(shared_ids)
+        need_total = self.kv_pool.blocks_for(max(total, 1))
+        priv = self.kv_pool.alloc(need_total - covered)  # PoolExhausted → caller
+        if shared_ids:
+            self.kv_pool.ref(shared_ids)  # the row's own pin
+        ids_all = shared_ids + priv
+        self._assign_row_blocks(row, ids_all)
+        self._device_tables()
+
+        # scatter the un-shared prefix slabs (all of them on a miss; just
+        # the partial tail block on a hit) from the splice buffer
+        nbp = P // bs
+        scatter_ids = np.zeros((nbp,), np.int32)
+        for j in range(covered, min(self.kv_pool.blocks_for(plen), nbp)):
+            scatter_ids[j] = ids_all[j]
+        try:
+            if scatter_ids.any():
+                self._cache = self._get("prefix_scatter", P, 0)(
+                    self._cache, tuple(self._put(p) for p in prefix.planes),
+                    self._put(jnp.asarray(scatter_ids)),
+                )
+            self._cache, tok0s = self._get("prefill_px_paged", C, 0)(
+                self.params, self._cache,
+                self._put(jnp.asarray(self._tables_host[row : row + 1])),
+                self._put(toks), self._put(jnp.int32(slen)),
+                self._put(jnp.int32(plen)), self._put(folded),
+            )
+        except BaseException as e:  # noqa: BLE001 — donated arena invalidated
+            self.reset()
+            raise EngineStateLost("prefixed insert failed; engine state reset") from e
+
+        # register a first-seen prefix's full blocks for future sharing
+        full_n = plen // bs
+        shared_tok = covered * bs  # tokens this row serves from shared blocks
+        if key is not None and not shared_ids and full_n > 0:
+            reg = ids_all[:full_n]
+            self.kv_pool.ref(reg)  # the cache's own ref outlives the row
+            self._prefix_blocks[key] = (list(reg), full_n * bs, plen)
+            self._registered_tokens += full_n * bs
+            shared_tok = full_n * bs  # now registration-counted, not row-counted
+            while len(self._prefix_blocks) > 8:  # bounded registration set
+                old_key = next(iter(self._prefix_blocks))
+                old_ids, old_cov, _ = self._prefix_blocks.pop(old_key)
+                self._registered_tokens -= old_cov
+                self.kv_pool.free(old_ids)
+
+        tok0 = int(np.asarray(tok0s)[0])
+        self._kv_len = self._kv_len.at[row].set(total)
+        self._last_tok = self._last_tok.at[row].set(tok0)
+        self._rng_keys = self._rng_keys.at[row].set(self._put(row_key))
+        self.stats.generate_calls += 1
+        self.stats.prefill_tokens += slen
+        self.stats.prefill_tokens_skipped += plen
+        if tok0 in self.config.eos_token_ids or max_new_c <= 1:
+            out = [] if tok0 in self.config.eos_token_ids else [tok0]
+            self.stats.decode_tokens += len(out)
+            self._blocks_at_retire[request_id] = len(self._slot_blocks[row])
+            self._release_row(row)
+            return row, out
+        self._active = self._active.at[row].set(True)
+        self._admit_seq += 1
+        self.slots[row] = _Slot(
+            request_id=request_id, tokens=[tok0], remaining=max_new_c - 1,
+            active=True, kv_ub=total, admit_seq=self._admit_seq,
+            prompt_len=total, shared_tokens=shared_tok,
         )
         self.stats.decode_tokens += 1
         return row, None
@@ -655,6 +892,455 @@ class ContinuousEngine:
 
 
     # ------------------------------------------------------------------
+    # paged executables (EngineConfig.kv_paged)
+    # ------------------------------------------------------------------
+    def _arena_avals(self):
+        """ShapeDtypeStructs for the arena plane tuple."""
+        L, K, hd = self.config.num_layers, self.config.num_kv_heads, self.config.head_dim
+        N, bs = self.kv_pool.num_blocks, self.block_size
+        cdt = jnp.int8 if self.kv_quant == "int8" else self.dtypes.compute_dtype
+        rep = self.mesh.replicated if self.mesh is not None else None
+        payload = jax.ShapeDtypeStruct((L, N, K, bs, hd), cdt, sharding=rep)
+        if self.kv_quant == "int8":
+            scale = jax.ShapeDtypeStruct((L, N, K, bs), jnp.float32, sharding=rep)
+            return (payload, payload, scale, scale)
+        return (payload, payload)
+
+    def _build_prefill_paged(self, S: int, n: int = 1):
+        """Paged admission prefill: ``n`` RIGHT-padded prompts (logical
+        positions start at 0 — the layout that makes prefix blocks shareable
+        and pad cost zero) prefill into a fresh dense ``[n, S]`` build cache;
+        the insert executable scatters the rows into pool blocks. Per-row
+        real lengths ride as a vector: the first token samples at each row's
+        OWN last real position (vector ``logit_index``), so mixed-length
+        admission groups still share one executable."""
+        cfg, dt, sampling = self.config, self.dtypes, self.sampling
+        model = self.model
+        kv_quant = self.kv_quant
+        i32 = jnp.int32
+
+        def prefill(params, tokens, lens, rngs):
+            cache = make_kv_cache(cfg, n, S, dt.compute_dtype, quant=kv_quant)
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=i32)[None, :], (n, S))
+            logits, cache = model.apply(
+                {"params": params}, tokens, positions, cache,
+                jnp.zeros((n,), i32), lens.astype(i32), jnp.int32(0),
+                logit_index=jnp.maximum(lens.astype(i32) - 1, 0),
+            )
+            tok0 = sample_token_per_row(rngs, logits[:, -1], sampling)
+            rows = (
+                (cache.k, cache.v, cache.k_scale, cache.v_scale)
+                if kv_quant == "int8" else (cache.k, cache.v)
+            )
+            return rows, tok0
+
+        rep = self.mesh.replicated if self.mesh is not None else None
+        return jax.jit(prefill).lower(
+            param_avals(self.params),
+            jax.ShapeDtypeStruct((n, S), jnp.int32, sharding=rep),
+            jax.ShapeDtypeStruct((n,), jnp.int32, sharding=rep),
+            jax.ShapeDtypeStruct((n, 2), jnp.uint32, sharding=rep),
+        ).compile()
+
+    def _build_insert_paged(self, S: int, n: int = 1):
+        """Scatter ``n`` freshly prefilled rows into their pool blocks (ONE
+        device call for the group) + splice per-row state. The block loop is
+        static (``S // block_size`` slabs per row); slabs whose logical block
+        a short prompt never reached carry id 0 — their junk lands in the
+        reserved null block, which nothing ever reads, so lazy allocation
+        costs no executable shapes."""
+        bs = self.block_size
+        nb = S // bs
+
+        def insert(arena, row_cache, kv_len, last_tok, active, rng_keys,
+                   rows, block_ids, lens, tok0s, row_keys):
+            # ONE scatter per plane over the block axis: reshape each row's
+            # S-length planes into n*nb slabs and write them at their
+            # table-assigned physical ids. An unrolled dynamic_update_slice
+            # loop here multiplied the executable's HLO by S/bs (up to
+            # hundreds of ops per plane) and with it the warmup compile
+            # time; a scatter is fine on this PER-ADMISSION path (the
+            # no-scatter rule protects the per-STEP write only). Slabs of
+            # never-reached blocks carry id 0 — duplicate null-block
+            # indices race, and the null block's content is don't-care.
+            flat_ids = block_ids.reshape(-1)  # [n * nb]
+            new = []
+            for a, r in zip(arena, row_cache):
+                L, K = r.shape[0], r.shape[2]
+                if a.ndim == 5:
+                    hd = r.shape[4]
+                    slabs = r.reshape(L, n, K, nb, bs, hd).transpose(
+                        0, 1, 3, 2, 4, 5
+                    ).reshape(L, n * nb, K, bs, hd)
+                else:
+                    slabs = r.reshape(L, n, K, nb, bs).transpose(
+                        0, 1, 3, 2, 4
+                    ).reshape(L, n * nb, K, bs)
+                new.append(a.at[:, flat_ids].set(slabs.astype(a.dtype)))
+            for i in range(n):
+                kv_len = kv_len.at[rows[i]].set(lens[i])
+                last_tok = last_tok.at[rows[i]].set(tok0s[i])
+                active = active.at[rows[i]].set(True)
+                rng_keys = rng_keys.at[rows[i]].set(row_keys[i])
+            return tuple(new), kv_len, last_tok, active, rng_keys
+
+        i32 = jnp.int32
+        rep = self.mesh.replicated if self.mesh is not None else None
+        row_avals = self._cache_avals(n, S)
+        return jax.jit(insert, donate_argnums=(0, 2, 3, 5)).lower(
+            self._arena_avals(),
+            row_avals,
+            jax.ShapeDtypeStruct((self.B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((self.B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((self.B,), bool, sharding=rep),
+            jax.ShapeDtypeStruct((self.B, 2), jnp.uint32, sharding=rep),
+            jax.ShapeDtypeStruct((n,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((n, nb), i32, sharding=rep),
+            jax.ShapeDtypeStruct((n,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((n,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((n, 2), jnp.uint32, sharding=rep),
+        ).compile()
+
+    def _build_step_paged(self, k: int = 1):
+        """The paged decode executable: identical control flow to
+        ``_build_step`` — the model streams each row's LIVE blocks via its
+        table instead of a dense ``T`` window, so step bandwidth scales with
+        real tokens. Tables are NOT donated (host-maintained; one device
+        copy serves many windows)."""
+        cfg, dt, sampling = self.config, self.dtypes, self.sampling
+        model = self.model_step_paged
+        eos_ids = cfg.eos_token_ids
+        B = self.B
+        Tmax = self.MB * self.block_size
+        kv_quant = self.kv_quant
+        from rag_llm_k8s_tpu.models.llama import KVCache
+
+        def one(params, cache_t, tables, kv_len, last_tok, active, rng_keys):
+            wi = jnp.where(active, kv_len, 0)  # inactive rows park at 0
+            # an inactive row's junk write must land in the NULL block, not
+            # table[row, 0]: a row that hit EOS mid-window still has its
+            # real table mapped (the host nulls it only at drain, after the
+            # window), and logical block 0 can be a REF-SHARED prefix block
+            # — writing there would corrupt every sharer's KV silently
+            tables_eff = jnp.where(active[:, None], tables, NULL_BLOCK)
+            logits, cache = model.apply(
+                {"params": params}, last_tok[:, None], wi[:, None],
+                KVCache(*cache_t), jnp.zeros((B,), jnp.int32), wi + 1, wi,
+                block_tables=tables_eff,
+            )
+            # same (seed, position) key fold as the dense step — a request
+            # samples identically under either cache layout
+            keys = jax.vmap(jax.random.fold_in)(rng_keys, wi + 1)
+            tok = sample_token_per_row(keys, logits[:, 0], sampling)
+            hit_eos = _isin(tok, eos_ids)
+            kv_len = jnp.where(active, jnp.minimum(wi + 1, Tmax - 1), kv_len)
+            active = active & ~hit_eos
+            out = (
+                (cache.k, cache.v, cache.k_scale, cache.v_scale)
+                if kv_quant == "int8" else (cache.k, cache.v)
+            )
+            return out, kv_len, tok, hit_eos, active
+
+        def step(params, cache_t, tables, kv_len, last_tok, active, rng_keys):
+            if k == 1:
+                cache_t, kv_len, tok, hit_eos, active = one(
+                    params, cache_t, tables, kv_len, last_tok, active, rng_keys
+                )
+                return cache_t, kv_len, tok, tok[None], hit_eos[None], active
+
+            def body(carry, _):
+                cache_t, kv_len, last_tok, active = carry
+                cache_t, kv_len, tok, hit_eos, active = one(
+                    params, cache_t, tables, kv_len, last_tok, active, rng_keys
+                )
+                return (cache_t, kv_len, tok, active), (tok, hit_eos)
+
+            (cache_t, kv_len, tok, active), (toks, eoss) = jax.lax.scan(
+                body, (cache_t, kv_len, last_tok, active), None, length=k
+            )
+            return cache_t, kv_len, tok, toks, eoss, active
+
+        i32 = jnp.int32
+        rep = self.mesh.replicated if self.mesh is not None else None
+        return jax.jit(step, donate_argnums=(1, 3, 4, 5)).lower(
+            param_avals(self.params),
+            self._arena_avals(),
+            jax.ShapeDtypeStruct((B, self.MB), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), bool, sharding=rep),
+            jax.ShapeDtypeStruct((B, 2), jnp.uint32, sharding=rep),
+        ).compile()
+
+    def _build_prefix_scatter(self, P: int):
+        """Scatter a ``CachedPrefix``'s splice-buffer planes into pool
+        blocks: a static loop over the buffer's ``P // block_size`` slabs,
+        each landing at its table-assigned physical block (id 0 = the null
+        block for slabs past the real prefix — junk nothing reads). Serves
+        both the miss path (all blocks private) and the hit path (shared
+        blocks carry id 0 here — already populated, skip the write)."""
+        bs = self.block_size
+        nbp = P // bs  # admit_prefixed validates P % block_size == 0
+
+        def scatter(arena, planes, ids):
+            # ONE scatter per plane (same shape discipline as insert_paged:
+            # an unrolled loop here emitted P/bs slice/update pairs per
+            # plane — hundreds of HLO ops at the 4096-token default buffer)
+            new = []
+            for a, p in zip(arena, planes):
+                L, K = p.shape[0], p.shape[2]
+                if a.ndim == 5:
+                    hd = p.shape[4]
+                    slabs = p[:, 0, :, : nbp * bs].reshape(
+                        L, K, nbp, bs, hd
+                    ).transpose(0, 2, 1, 3, 4)  # [L, nbp, K, bs, hd]
+                else:
+                    slabs = p[:, 0, :, : nbp * bs].reshape(
+                        L, K, nbp, bs
+                    ).transpose(0, 2, 1, 3)
+                new.append(a.at[:, ids].set(slabs.astype(a.dtype)))
+            return tuple(new)
+
+        i32 = jnp.int32
+        rep = self.mesh.replicated if self.mesh is not None else None
+        plane_avals = tuple(
+            jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
+            for shape, dtype in self._prefix_plane_shapes(P)
+        )
+        return jax.jit(scatter, donate_argnums=(0,)).lower(
+            self._arena_avals(),
+            plane_avals,
+            jax.ShapeDtypeStruct((nbp,), i32, sharding=rep),
+        ).compile()
+
+    def _build_prefill_px_paged(self, C: int):
+        """Paged PREFIXED admission, batch 1: the prefix KV already sits in
+        this row's pool blocks (shared copy-free via ref counts, or freshly
+        scattered from the descriptor); only the ``C``-bucketed suffix
+        prefills, as a paged CHUNK over the row's table (queries at logical
+        ``plen + t``, offset causality). Writes go straight into pool
+        blocks — no per-row ``(S,)`` cache materialization or splice."""
+        cfg, dt, sampling = self.config, self.dtypes, self.sampling
+        model = self.model_chunked_paged
+        kv_quant = self.kv_quant
+        i32 = jnp.int32
+        from rag_llm_k8s_tpu.models.llama import KVCache
+
+        def px(params, arena, row_table, suffix_tokens, slen, plen, rngs):
+            positions = (plen + jnp.arange(C, dtype=i32))[None, :]
+            total = (plen + slen).astype(i32)
+            logits, cache = model.apply(
+                {"params": params}, suffix_tokens, positions,
+                KVCache(*arena), jnp.zeros((1,), i32),
+                jnp.broadcast_to(total, (1,)), jnp.broadcast_to(plen, (1,)),
+                logit_index=jnp.maximum(slen - 1, 0),
+                block_tables=row_table,
+            )
+            tok0 = sample_token_per_row(rngs, logits[:, -1], sampling)
+            out = (
+                (cache.k, cache.v, cache.k_scale, cache.v_scale)
+                if kv_quant == "int8" else (cache.k, cache.v)
+            )
+            return out, tok0
+
+        rep = self.mesh.replicated if self.mesh is not None else None
+        return jax.jit(px, donate_argnums=(1,)).lower(
+            param_avals(self.params),
+            self._arena_avals(),
+            jax.ShapeDtypeStruct((1, self.MB), i32, sharding=rep),
+            jax.ShapeDtypeStruct((1, C), jnp.int32, sharding=rep),
+            jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
+            jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
+            jax.ShapeDtypeStruct((1, 2), jnp.uint32, sharding=rep),
+        ).compile()
+
+    # ------------------------------------------------------------------
+    # paged host bookkeeping (scheduler thread only, like the operations)
+    # ------------------------------------------------------------------
+    def _device_tables(self):
+        """The device copy of the block tables, refreshed only when the host
+        tables changed (admission, growth, retire) — a [B, MB] int32 put,
+        tiny next to any step."""
+        if self._tables_dirty or self._tables_dev is None:
+            self._tables_dev = self._put(jnp.asarray(self._tables_host))
+            self._tables_dirty = False
+        return self._tables_dev
+
+    def _assign_row_blocks(self, row: int, ids: List[int], start_block: int = 0):
+        """Map ``ids`` into the row's table at logical blocks
+        ``[start_block, ...)`` and record ownership."""
+        for j, b in enumerate(ids):
+            self._tables_host[row, start_block + j] = b
+        self._slot_blocks[row].extend(ids)
+        self._tables_dirty = True
+
+    def _release_row(self, row: int) -> None:
+        """Return the row's blocks to the pool and null its table — MUST
+        happen before the next step: an inactive row still writes its junk
+        token at table[row, 0], and a stale entry would corrupt whoever the
+        freed block is reallocated to."""
+        if self._slot_blocks[row]:
+            self.kv_pool.free(self._slot_blocks[row])
+            self._slot_blocks[row] = []
+        if self._tables_host[row].any():
+            self._tables_host[row, :] = NULL_BLOCK
+            self._tables_dirty = True
+
+    def _retire_rows(self, rows: List[int]) -> None:
+        """Paged-mode retire hook (budget/EOS/evict): record the per-request
+        block footprint, then free."""
+        if not self.paged:
+            return
+        if len(self._blocks_at_retire) > 8192:
+            # raw-engine callers (tests, benches) never pop; don't let the
+            # footprint map grow without bound under them
+            self._blocks_at_retire.clear()
+        for r in rows:
+            rid = self.slots[r].request_id
+            if rid >= 0:
+                self._blocks_at_retire[rid] = len(self._slot_blocks[r])
+            self._release_row(r)
+
+    def pop_blocks_allocated(self, request_id: int) -> Optional[int]:
+        """Blocks the request held at retirement (paged; None otherwise) —
+        the scheduler forwards it into the response timings."""
+        if not self.paged:
+            return None
+        return self._blocks_at_retire.pop(request_id, None)
+
+    def blocks_needed(self, prompt_len: int) -> int:
+        """Admission-time block cost of a prompt (0 in dense mode)."""
+        if not self.paged:
+            return 0
+        return self.kv_pool.blocks_for(max(int(prompt_len), 1))
+
+    def admission_state(self, prompt_len: int) -> str:
+        """'ok' — admissible now; 'wait' — pool pressure, decode will free
+        blocks; 'never' — the prompt alone outsizes the whole pool."""
+        if not self.paged:
+            return "ok"
+        need = self.blocks_needed(prompt_len)
+        if need > self.kv_pool.usable_blocks():
+            return "never"
+        # +1 headroom: the first decode window must be able to open the
+        # next block, or admission instantly preempts what it just
+        # admitted. Capped at MB — a row's lifetime growth never exceeds
+        # one full window of blocks, so a prompt that exactly fills the
+        # pool's row capacity needs no headroom at all (without the cap a
+        # minimum-size pool would 'never' a prompt it can fully serve)
+        want = min(need + 1, self.MB)
+        if self.kv_pool.can_alloc(want):
+            return "ok"
+        if self._prefix_blocks and not self.has_active():
+            # nothing is decoding, yet the pool can't take one prompt: the
+            # registered prefix blocks are the only other holder — drop the
+            # oldest registrations until the admission fits (cache refs are
+            # re-buildable; a wedged queue is not)
+            for key in list(self._prefix_blocks):
+                ids, cov, _ = self._prefix_blocks.pop(key)
+                self._registered_tokens -= cov
+                self.kv_pool.free(ids)
+                if self.kv_pool.can_alloc(want):
+                    return "ok"
+        return "wait" if self.has_active() else (
+            "ok" if self.kv_pool.can_alloc(want) else "never"
+        )
+
+    def _ensure_decode_blocks(self) -> None:
+        """Grow every active row's table to cover the next sync window
+        (positions up to ``kv_ub + k``) BEFORE the device call — a write
+        landing in an unmapped block would vanish into the null block and
+        corrupt the stream one step later. Exhaustion preempts the
+        NEWEST-admitted rows (their emitted tokens return to the scheduler,
+        which resubmits once blocks free — vLLM-style recompute preemption)
+        until the remaining rows fit."""
+        k = self.sync_steps
+        bs = self.block_size
+        while True:
+            short = []  # (admit_seq, row, blocks_missing, blocks_have)
+            for row, slot in enumerate(self.slots):
+                if not slot.active:
+                    continue
+                # mapped logical blocks are contiguous from 0, so the
+                # ownership list IS the count — no B x MB table rescan on
+                # the hot per-window path
+                have = len(self._slot_blocks[row])
+                need_total = min(
+                    -(-(slot.kv_ub + k) // bs), self.MB
+                )
+                if need_total > have:
+                    short.append((slot.admit_seq, row, need_total - have, have))
+            if not short:
+                return
+            short.sort()  # oldest admissions grow first
+            ok = True
+            for _, row, missing, have in short:
+                try:
+                    ids = self.kv_pool.alloc(missing)
+                except PoolExhausted:
+                    ok = False
+                    break
+                self._assign_row_blocks(row, ids, start_block=have)
+            if ok:
+                return
+            # growth blocked: drop registered prefix blocks first (cache
+            # refs are re-buildable; without this a lone active row whose
+            # growth the registrations crowd out would preempt ITSELF in a
+            # loop), then preempt the newest active row and retry
+            if self._prefix_blocks:
+                old_key = next(iter(self._prefix_blocks))
+                old_ids, old_cov, _ = self._prefix_blocks.pop(old_key)
+                self._registered_tokens -= old_cov
+                self.kv_pool.free(old_ids)
+                continue
+            victims = [
+                (s.admit_seq, r) for r, s in enumerate(self.slots) if s.active
+            ]
+            victims.sort()
+            seq, victim = victims[-1]
+            vslot = self.slots[victim]
+            logger.warning(
+                "kv pool exhausted mid-decode; preempting request %d "
+                "(%d blocks back to the pool)",
+                vslot.request_id, len(self._slot_blocks[victim]),
+            )
+            self._preempted.append((vslot.request_id, list(vslot.tokens)))
+            self._m_pool_preempt.inc()
+            m = np.ones(self.B, bool)
+            m[victim] = False
+            self._active = self._active & self._put(jnp.asarray(m))
+            self._release_row(victim)
+            self.slots[victim] = _Slot()
+
+    def drain_preempted(self) -> List[Tuple[int, List[int]]]:
+        """Requests preempted by pool exhaustion since the last drain, as
+        ``(request_id, emitted_tokens)`` — the scheduler resubmits them
+        (prompt + emitted, budget reduced), so preemption is invisible to
+        callers beyond latency."""
+        if not self.paged or not self._preempted:
+            return []
+        out, self._preempted = self._preempted, []
+        return out
+
+    def pool_used_tokens(self) -> int:
+        """Live logical tokens across UNIQUE pool blocks (host mirrors) —
+        the numerator of the fragmentation gauge. Ref-shared prefix blocks
+        count once, via their registration: each sharing row subtracts the
+        tokens its table serves from shared blocks (a row whose
+        registration was since dropped briefly over-reports fragmentation —
+        a gauge-grade approximation, clamped by the pool)."""
+        if not self.paged:
+            return 0
+        rows = sum(
+            max(s.kv_ub - s.shared_tokens, 0) for s in self.slots if s.active
+        )
+        # the registration total is a single int maintained on the
+        # scheduler thread — iterating _prefix_blocks here would race the
+        # scheduler's register/evict and crash a /metrics scrape with
+        # "dictionary changed size during iteration"
+        return rows + self._registered_tokens
+
+    # ------------------------------------------------------------------
     # operations (called by the scheduler thread only)
     # ------------------------------------------------------------------
     def free_slots(self) -> List[int]:
@@ -678,6 +1364,7 @@ class ContinuousEngine:
             m = np.ones(self.B, bool)
             m[rows] = False
             self._active = self._active & self._put(jnp.asarray(m))
+            self._retire_rows(rows)  # paged: blocks back to the free list
             for r in rows:
                 self.slots[r] = _Slot()
         return rows
@@ -767,6 +1454,8 @@ class ContinuousEngine:
 
     def _admit_chunk(self, S: int, chunk, rows: List[int], results: List):
         """One batched prefill + insert + first-token fetch for ``chunk``."""
+        if self.paged:
+            return self._admit_chunk_paged(S, chunk, rows, results)
         t_admit = time.perf_counter()
         n = len(chunk)
         tokens = np.full((n, S), self.pad_id, np.int32)
@@ -848,18 +1537,141 @@ class ContinuousEngine:
                 self.slots[row] = _Slot()  # fresh inactive slot
             raise
 
+    def _admit_chunk_paged(self, S: int, chunk, rows: List[int], results: List):
+        """Paged twin of ``_admit_chunk``: allocate each row's blocks, one
+        RIGHT-padded batched prefill, one scatter-insert into the arena —
+        no per-row ``(S,)`` cache splice survives past the insert call.
+        ``PoolExhausted`` during allocation is backpressure, not failure:
+        already-taken blocks return and the exception propagates so the
+        scheduler can requeue the chunk's items."""
+        t_admit = time.perf_counter()
+        n = len(chunk)
+        bs = self.block_size
+        nb = S // bs
+        taken: List[Tuple[int, List[int]]] = []  # (row, ids)
+        block_ids = np.zeros((n, nb), np.int32)  # NULL beyond a row's need
+        lens = np.zeros((n,), np.int32)
+        try:
+            for r, (_, _, _, p, _, _) in enumerate(chunk):
+                need = self.kv_pool.blocks_for(max(len(p), 1))
+                ids = self.kv_pool.alloc(need)
+                taken.append((rows[r], ids))
+                block_ids[r, : len(ids)] = ids
+                lens[r] = len(p)
+        except PoolExhausted:
+            for _, ids in taken:
+                self.kv_pool.free(ids)
+            raise
+        tokens = np.full((n, S), self.pad_id, np.int32)
+        folded_keys, base_keys = [], []
+        for r, (_, _, _, p, _, row_key) in enumerate(chunk):
+            tokens[r, : len(p)] = p  # RIGHT-padded: logical positions 0..len
+            # same (seed, position) fold as the dense path: the first
+            # sampled token sits at canonical position len(p) either way
+            folded_keys.append(jax.random.fold_in(row_key, len(p)))
+            base_keys.append(row_key)
+        folded = jnp.stack(folded_keys)
+        row_keys = jnp.stack(base_keys)
+
+        for row, ids in taken:
+            self._assign_row_blocks(row, ids)
+        self._device_tables()  # refresh before anything can step
+
+        try:
+            row_cache, tok0s = self._get("prefill_paged", S, n)(
+                self.params, self._put(tokens), self._put(jnp.asarray(lens)),
+                self._put(folded),
+            )
+        except BaseException:  # noqa: BLE001 — nothing donated yet
+            # the prefill touches none of the engine's donated state, so
+            # per-chunk isolation is enough — but the blocks taken above
+            # must go back and the tables re-null, or a one-off device
+            # error becomes a permanent pool leak on inactive rows
+            for row, _ in taken:
+                self._release_row(row)
+            raise
+        try:
+            faults.maybe_fail("insert")
+            (self._cache, self._kv_len, self._last_tok,
+             self._active, self._rng_keys) = self._get("insert_paged", S, n)(
+                self._cache, row_cache,
+                self._kv_len, self._last_tok, self._active, self._rng_keys,
+                self._put(np.asarray(rows, np.int32)),
+                self._put(jnp.asarray(block_ids)),
+                self._put(jnp.asarray(lens)), tok0s, self._put(row_keys),
+            )
+        except BaseException as e:  # noqa: BLE001 — donated arena invalidated
+            self.reset()
+            raise EngineStateLost("insert failed; engine state reset") from e
+
+        try:
+            tok0_h = np.asarray(tok0s)  # ONE fetch for the whole chunk
+            self._m_step_admit.observe(time.perf_counter() - t_admit)
+            deactivate = []
+            for r, (i, rid, _, p, max_new_c, _) in enumerate(chunk):
+                tok0 = int(tok0_h[r])
+                row = rows[r]
+                self.stats.generate_calls += 1
+                self.stats.prefill_tokens += len(p)
+                if tok0 in self.config.eos_token_ids or max_new_c <= 1:
+                    out = [] if tok0 in self.config.eos_token_ids else [tok0]
+                    self.stats.decode_tokens += len(out)
+                    deactivate.append(row)
+                    self._blocks_at_retire[rid] = len(self._slot_blocks[row])
+                    self._release_row(row)
+                    results[i] = (row, out)
+                    continue
+                self._admit_seq += 1
+                self.slots[row] = _Slot(
+                    request_id=rid, tokens=[tok0], remaining=max_new_c - 1,
+                    active=True, kv_ub=len(p), admit_seq=self._admit_seq,
+                    prompt_len=len(p),
+                )
+                self.stats.decode_tokens += 1
+                results[i] = (row, None)
+            if deactivate:
+                m = np.ones(self.B, bool)
+                m[deactivate] = False
+                self._active = self._active & self._put(jnp.asarray(m))
+        except BaseException:  # noqa: BLE001 — release before isolation
+            m = np.ones(self.B, bool)
+            m[rows] = False
+            self._active = self._active & self._put(jnp.asarray(m))
+            for row in rows:
+                self._release_row(row)
+                self.slots[row] = _Slot()
+            raise
+
     def step(self) -> List[Tuple[int, List[int]]]:
         """``decode_sync_steps`` decode steps for every active slot in one
         device call + one host fetch. Returns completed requests as
         ``(request_id, tokens)`` and frees their slots."""
         faults.maybe_fail("decode_step")
         k = self.sync_steps
+        if self.paged:
+            # map the blocks this window will write BEFORE dispatch (an
+            # unmapped write vanishes into the null block and corrupts the
+            # stream one step later); exhaustion preempts the newest rows
+            self._ensure_decode_blocks()
+            if not self.has_active():
+                return []  # everything was preempted: nothing to step
         t0 = time.perf_counter()
-        (self._cache, self._kv_len, self._last_tok, toks, eoss,
-         self._active) = self._get("step", k)(
-            self.params, self._cache, self._kv_start,
-            self._kv_len, self._last_tok, self._active, self._rng_keys,
-        )
+        if self.paged:
+            (self._cache, self._kv_len, self._last_tok, toks, eoss,
+             self._active) = self._get("step_paged", k)(
+                self.params, self._cache, self._device_tables(),
+                self._kv_len, self._last_tok, self._active, self._rng_keys,
+            )
+            Tmax = self.MB * self.block_size
+            for slot in self.slots:
+                if slot.active:
+                    slot.kv_ub = min(slot.kv_ub + k, Tmax - 1)
+        else:
+            (self._cache, self._kv_len, self._last_tok, toks, eoss,
+             self._active) = self._get("step", k)(
+                self.params, self._cache, self._kv_start,
+                self._kv_len, self._last_tok, self._active, self._rng_keys,
+            )
         self.steps += k
         tok_h = np.asarray(toks)  # [k, B]
         # EXACT inter-token latency: one sync window (device step + the
@@ -895,6 +1707,7 @@ class ContinuousEngine:
             mask = np.ones(self.B, bool)
             mask[deactivate] = False
             self._active = self._active & self._put(jnp.asarray(mask))
+            self._retire_rows(deactivate)  # paged: blocks back to the pool
         self._m_step_drain.observe(time.perf_counter() - t_fetch)
         return done
 
@@ -978,6 +1791,7 @@ class ContinuousScheduler:
         seed: Optional[int] = None,  # honored per-row: draws are seed+position keyed
         timeout: Optional[float] = None,
         deadline: Optional[Deadline] = None,
+        info: Optional[Dict] = None,  # out-param: per-request engine facts
     ) -> List[int]:
         if self._stop.is_set():
             raise RuntimeError("scheduler is shut down")
@@ -1015,6 +1829,10 @@ class ContinuousScheduler:
             raise TimeoutError("generation timed out")
         if item.error is not None:
             raise item.error
+        if info is not None and item.blocks_allocated is not None:
+            # paged mode: the row's peak block footprint (per-row
+            # blocks_allocated in the /generate timings block)
+            info["kv_blocks_allocated"] = item.blocks_allocated
         return item.result
 
     def shutdown(self):
@@ -1092,6 +1910,24 @@ class ContinuousScheduler:
                     # overload this is what keeps dead work off the device
                     item = self._next_nowait()
                     continue
+                # paged backpressure: a pool that can't take this prompt NOW
+                # keeps it QUEUED (decode frees blocks every window; the
+                # growing queue is what trips the PR-4 admission gate's 429s
+                # upstream) — only a prompt the whole pool couldn't hold
+                # fails outright
+                state = eng.admission_state(len(item.prompt))
+                if state == "never":
+                    item.error = PoolExhausted(
+                        eng.blocks_needed(len(item.prompt)),
+                        eng.kv_pool.usable_blocks() if eng.kv_pool else 0,
+                    )
+                    item.done.set()
+                    item = self._next_nowait()
+                    continue
+                if state == "wait":
+                    self._safe_step(waiting)
+                    self._evict_expired(waiting)
+                    continue
                 free = eng.free_slots()
                 if not free:
                     # no room: decode until a slot frees, then admit
@@ -1117,6 +1953,12 @@ class ContinuousScheduler:
                         [(b.request_id, b.prompt, b.max_new, b.seed) for b in batch]
                     )
                     for b, res in zip(batch, admitted):
+                        if isinstance(res, PoolExhausted):
+                            # the chunk raced the pool (another chunk of
+                            # this very group took the blocks): requeue —
+                            # this is backpressure, not a failure
+                            self._queue.put(b)
+                            continue
                         if isinstance(res, BaseException):
                             # per-chunk failure: only ITS items fail; other
                             # chunks' admissions stand and keep decoding
@@ -1130,8 +1972,9 @@ class ContinuousScheduler:
                         # A resubmitted request already observed its real
                         # TTFT on the first attempt — a second sample would
                         # double-count it and fold the reset backoff into
-                        # the histogram the SLO layer alerts on
-                        if not b.retried:
+                        # the histogram the SLO layer alerts on (same for a
+                        # pool-preemption resume)
+                        if not b.retried and not b.resumed:
                             eng._m_ttft.observe(time.monotonic() - b.t_submit)
                         if finished is not None:
                             self._deliver(b, finished)
@@ -1191,8 +2034,35 @@ class ContinuousScheduler:
         (if any) prepend the continuation — the client sees one stream."""
         if item.retried:
             self._m_retries.labels(outcome="succeeded").inc()
+        item.blocks_allocated = self.engine.pop_blocks_allocated(item.request_id)
         item.result = item.emitted + tokens
         item.done.set()
+
+    def _fold_emitted(self, it: "_Pending", toks: List[int]) -> None:
+        """Fold already-emitted tokens into a request about to resubmit:
+        resume only when prompt+emitted still fits a slot — past the
+        largest bucket admit_many would silently left-truncate the context
+        and the "seamless continuation" would be conditioned on a different
+        prompt; restarting from scratch is exact. Shared by reset recovery
+        and pool-preemption resume."""
+        if toks and len(it.prompt) + len(toks) <= max(self.engine.buckets):
+            it.emitted.extend(toks)
+            it.prompt = list(it.prompt) + toks
+            it.max_new = max(1, it.max_new - len(toks))
+
+    def _resume_preempted(self, waiting: Dict[int, "_Pending"]):
+        """Requeue requests the paged engine preempted on pool exhaustion:
+        prompt + emitted resubmits (greedy streams provably identical), the
+        budget shrinks by what was already produced. Unlike reset recovery
+        this burns no retry — preemption is scheduled backpressure, not a
+        fault — and the TTFT histogram is not re-fed."""
+        for rid, toks in self.engine.drain_preempted():
+            it = waiting.pop(rid, None)
+            if it is None:
+                continue
+            self._fold_emitted(it, toks)
+            it.resumed = True
+            self._queue.put(it)
 
     def _handle_reset(self, cause, waiting, extra, emitted):
         """After an engine reset: resubmit what can still be served, fail
@@ -1224,17 +2094,8 @@ class ContinuousScheduler:
             # jittered: a device that just faulted gets a beat before the
             # retries' prefills land on it again
             time.sleep(random.uniform(0.5, 1.0) * self.retry_backoff_s)
-        largest = max(self.engine.buckets)
         for it in retry:
-            toks = emitted.get(it.request_id, [])
-            # resume only when prompt+emitted still fits a slot — past the
-            # largest bucket admit_many would silently left-truncate the
-            # context and the "seamless continuation" would be conditioned
-            # on a different prompt; restarting from scratch is exact
-            if toks and len(it.prompt) + len(toks) <= largest:
-                it.emitted.extend(toks)
-                it.prompt = list(it.prompt) + toks
-                it.max_new = max(1, it.max_new - len(toks))
+            self._fold_emitted(it, emitted.get(it.request_id, []))
             it.retries_left -= 1
             it.retried = True
             self._m_retries.labels(outcome="resubmitted").inc()
@@ -1247,6 +2108,7 @@ class ContinuousScheduler:
         of retries (or past deadline) get the error instead of a hang."""
         try:
             self._drain_done(self.engine.step(), waiting)
+            self._resume_preempted(waiting)
         except BaseException as e:  # noqa: BLE001 — recover, don't die
             logger.exception(
                 "decode step failed; recovering %d in-flight request(s)",
@@ -1287,3 +2149,5 @@ class _Pending:
     retried: bool = False  # ever resubmitted (success/failure accounting)
     emitted: List[int] = field(default_factory=list)  # pre-reset tokens
     abandoned: bool = False  # caller gave up (it counted the expiry)
+    resumed: bool = False  # requeued after a paged pool preemption
+    blocks_allocated: Optional[int] = None  # paged: peak block footprint

@@ -64,6 +64,13 @@ class CachedPrefix:
     capacity: int  # P — the static splice-buffer width
     reused_tokens: int  # tokens whose KV came from cache hits
     computed_tokens: int  # tokens prefilled (cache misses) to build this
+    # stable identity of the prefix CONTENT (the segment-key chain + total
+    # length), set only under exact-chain reuse: the paged continuous
+    # engine keys its block-granular sharing on it — two requests with the
+    # same chain_key map the same physical pool blocks copy-free
+    # (ref-counted; ContinuousEngine._admit_prefixed_paged). None under
+    # "slot" reuse, whose approximate blocks are NOT content-identical.
+    chain_key: Optional[Tuple] = None
 
 
 @dataclass
@@ -199,7 +206,10 @@ class PrefixCache:
                     chain = chain + (key,)
                 self.hits += len(segments)
                 self.tokens_reused += total
-                return CachedPrefix(memo[0], memo[1], P, total, 0)
+                return CachedPrefix(
+                    memo[0], memo[1], P, total, 0,
+                    chain_key=akey if self.config.reuse == "exact" else None,
+                )
 
         buf = self.engine.prefix_buffer_zero()
         off = 0
@@ -265,7 +275,10 @@ class PrefixCache:
                     continue
                 old_buf, _ = self._assembled.pop(k)
                 self.assembled_bytes -= _planes_nbytes(old_buf)
-        return CachedPrefix(buf, off, P, reused, computed)
+        return CachedPrefix(
+            buf, off, P, reused, computed,
+            chain_key=akey if self.config.reuse == "exact" else None,
+        )
 
     # -- LRU bookkeeping -------------------------------------------------
     def _insert(self, key, entry: _Entry) -> None:

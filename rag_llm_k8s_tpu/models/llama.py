@@ -47,6 +47,13 @@ from rag_llm_k8s_tpu.ops.attention import (
     decode_attention_xla,
     decode_attention_xla_q8,
     flash_attention,
+    paged_chunk_attention,
+    paged_chunk_attention_xla,
+    paged_chunk_attention_xla_q8,
+    paged_decode_attention,
+    paged_decode_attention_q8,
+    paged_decode_attention_xla,
+    paged_decode_attention_xla_q8,
     quantize_kv,
 )
 
@@ -91,6 +98,36 @@ def make_kv_cache(
         batch_size,
         config.num_kv_heads,
         max_seq_len,
+        config.head_dim,
+    )
+    if quant == "int8":
+        return KVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+            v_scale=jnp.zeros(shape[:-1], jnp.float32),
+        )
+    assert quant == "bf16", f"kv_quant={quant!r}: expected 'bf16' or 'int8'"
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def make_kv_arena(
+    config: LlamaConfig,
+    num_blocks: int,
+    block_size: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+    quant: str = "bf16",
+) -> KVCache:
+    """The PAGED cache: a ``[L, num_blocks, kv_heads, block_size, head_dim]``
+    block-pool arena (same plane tuple as :func:`make_kv_cache`, with the
+    per-row ``B × T`` axes replaced by the physical-block axis). Physical
+    block 0 is the engine's reserved null block (engine/kv_pool.py); rows
+    reach their blocks through int32 block tables, never by position."""
+    shape = (
+        config.num_layers,
+        num_blocks,
+        config.num_kv_heads,
+        block_size,
         config.head_dim,
     )
     if quant == "int8":
@@ -267,6 +304,17 @@ class Attention(nn.Module):
     # v_scale); fresh K/V quantize on write (ops.attention.quantize_kv) and
     # decode streams int8 blocks through decode_attention_q8.
     kv_quant: str = "bf16"
+    # STATIC paged-KV switch (block-pool arena): the cache carry planes are
+    # [L, N, K, block_size, hd] arenas and every call takes ``block_tables``
+    # [B, MB] int32 mapping logical block j of row b to a physical pool
+    # block. Paged rows are RIGHT-padded (logical positions start at 0, the
+    # window is [0, kv_len), kv_start is ignored); writes scatter through
+    # the table, attention streams only LIVE blocks (ops.attention paged
+    # kernels). Valid for decode (row_frontier) and chunked prefill — fresh
+    # whole-row prefill stays dense and is scattered in by the engine's
+    # insert executable. tp>1 routes to the sharding-transparent XLA paged
+    # path (no shard_map'd paged kernel yet).
+    paged: bool = False
 
     def _resolved_impl(self) -> str:
         if self.attn_impl not in ("auto", "pallas", "pallas_interpret", "xla"):
@@ -277,6 +325,55 @@ class Attention(nn.Module):
         if self.attn_impl == "auto":
             return "pallas" if jax.default_backend() == "tpu" else "xla"
         return self.attn_impl
+
+    def _attend_paged(
+        self, q, k, v, kv_len, layer, *, mode: str, block_tables,
+        write_index=None, scales=None,
+    ) -> jax.Array:
+        """Paged-arena dispatch: ``k``/``v`` are the [L, N, K, bs, hd]
+        arenas, the row's blocks resolve through ``block_tables``. tp>1
+        (or ``attn_impl="xla"``) takes the gather-based XLA path; the q8
+        CHUNK case always does (see paged_chunk_attention_xla_q8 — chunk
+        prefill is per-admission, the steady-state decode stays fused)."""
+        impl = self._resolved_impl()
+        mesh = self.mesh
+        tp = (
+            mesh.shape["tp"]
+            if mesh is not None and "tp" in mesh.axis_names
+            else 1
+        )
+        use_xla = impl == "xla" or tp > 1
+        interpret = impl == "pallas_interpret"
+        lay1 = jnp.asarray(layer, jnp.int32).reshape(1)
+        if mode == "decode":
+            if use_xla:
+                if scales is not None:
+                    return paged_decode_attention_xla_q8(
+                        q, k, v, scales[0], scales[1], block_tables, kv_len, lay1
+                    )
+                return paged_decode_attention_xla(
+                    q, k, v, block_tables, kv_len, lay1
+                )
+            if scales is not None:
+                return paged_decode_attention_q8(
+                    q, k, v, scales[0], scales[1], block_tables, kv_len, lay1,
+                    interpret=interpret,
+                )
+            return paged_decode_attention(
+                q, k, v, block_tables, kv_len, lay1, interpret=interpret
+            )
+        assert mode == "chunk", f"paged attention has no {mode!r} mode"
+        B = q.shape[0]
+        wi = jnp.broadcast_to(jnp.asarray(write_index, jnp.int32), (B,))
+        if scales is not None:
+            return paged_chunk_attention_xla_q8(
+                q, k, v, scales[0], scales[1], block_tables, kv_len, lay1, wi
+            )
+        if use_xla:
+            return paged_chunk_attention_xla(q, k, v, block_tables, kv_len, lay1, wi)
+        return paged_chunk_attention(
+            q, k, v, block_tables, kv_len, lay1, wi, interpret=interpret
+        )
 
     def _attend(
         self, q, k, v, kv_start, kv_len, layer, *, mode: str, write_index=None,
@@ -441,7 +538,8 @@ class Attention(nn.Module):
         kv_len: jax.Array,  # [B] int32: valid frontier (exclusive)
         cos: jax.Array,
         sin: jax.Array,
-        write_index: jax.Array,  # scalar int32
+        write_index: jax.Array,  # scalar int32 ([B] when row_frontier/paged)
+        block_tables=None,  # [B, MB] int32 (paged mode only)
     ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
         c, dt = self.config, self.dtypes
         B, S, D = x.shape
@@ -473,7 +571,58 @@ class Attention(nn.Module):
         else:
             k_cache, v_cache = kv
             k_w, v_w, k_s, v_s = k, v, None, None
-        if self.row_frontier and S == 1:
+        if self.paged:
+            assert block_tables is not None, "paged attention needs block_tables"
+            assert S == 1 or self.chunked, (
+                "paged mode serves decode (S=1) and chunked prefill; fresh "
+                "whole-row prefill stays dense (the engine scatters it in)"
+            )
+            # table-directed scatter write: token t of row b lands at
+            # logical position pos = write_index_b (+ t when chunked) →
+            # physical (block_tables[b, pos // bs], pos % bs). Built as a
+            # masked full-plane write like the dense row_frontier path (an
+            # XLA scatter re-materializes the arena — same trap the dense
+            # path measured at 2.6-12x step time): per (block, slot) the
+            # source token resolves by argmax over a [B*S, N, bs] mask and
+            # rides a gather; slots no token targets keep the old plane.
+            # Rows parked at the null block (inactive, or positions past a
+            # chunk's real suffix) write junk into block 0, which no kernel
+            # ever reads — that is the null block's whole job.
+            N_blocks, bs_len = k_cache.shape[1], k_cache.shape[3]
+            MB = block_tables.shape[1]
+            pos = jnp.asarray(write_index, jnp.int32).reshape(B, -1)
+            if self.chunked and S > 1:
+                pos = pos[:, :1] + jnp.arange(S, dtype=jnp.int32)[None, :]
+            blk = jnp.clip(pos // bs_len, 0, MB - 1)
+            phys = jnp.take_along_axis(block_tables.astype(jnp.int32), blk, axis=1)
+            off = pos % bs_len
+            flat_phys = phys.reshape(-1)  # [B*S]
+            flat_off = off.reshape(-1)
+            m = (
+                jnp.arange(N_blocks, dtype=jnp.int32)[None, :, None]
+                == flat_phys[:, None, None]
+            ) & (
+                jnp.arange(bs_len, dtype=jnp.int32)[None, None, :]
+                == flat_off[:, None, None]
+            )  # [B*S, N, bs]
+            src = jnp.argmax(m, axis=0)  # [N, bs] — source token per slot
+            written = jnp.any(m, axis=0)  # [N, bs]
+
+            def scatter_plane(cache, vals):
+                # vals [B, S, K, hd] (payload) or [B, S, K] (scale plane)
+                flat = vals.reshape((B * S,) + vals.shape[2:])
+                g = jnp.moveaxis(jnp.take(flat, src, axis=0), 2, 1)  # [N, K, bs(, hd)]
+                w = written[:, None, :] if g.ndim == 3 else written[:, None, :, None]
+                return cache.at[layer].set(
+                    jnp.where(w, g.astype(cache.dtype), cache[layer])
+                )
+
+            k_cache = scatter_plane(k_cache, k_w)
+            v_cache = scatter_plane(v_cache, v_w)
+            if q8:
+                ks_cache = scatter_plane(ks_cache, k_s)
+                vs_cache = scatter_plane(vs_cache, v_s)
+        elif self.row_frontier and S == 1:
             # continuous batching: write_index is [B] — each row's token
             # lands at that row's own frontier. NOT a gather-scatter
             # (.at[layer, b, :, wi_b].set): that lowers to an XLA scatter
@@ -523,7 +672,15 @@ class Attention(nn.Module):
                 )
 
         scales = (ks_cache, vs_cache) if q8 else None
-        if S == 1:
+        if self.paged:
+            out = self._attend_paged(
+                q, k_cache, v_cache, kv_len, layer,
+                mode="decode" if S == 1 else "chunk",
+                block_tables=block_tables,
+                write_index=write_index if S > 1 else None,
+                scales=scales,
+            )
+        elif S == 1:
             out = self._attend(
                 q, k_cache, v_cache, kv_start, kv_len, layer,
                 mode="decode", scales=scales,
@@ -591,17 +748,19 @@ class Block(nn.Module):
     fused_qkv: bool = False
     quantized: bool = False
     kv_quant: str = "bf16"
+    paged: bool = False
 
     @nn.compact
-    def __call__(self, carry, kv_start, kv_len, cos, sin, write_index):
+    def __call__(self, carry, kv_start, kv_len, cos, sin, write_index,
+                 block_tables):
         h, kv, layer = carry
         attn_out, kv = Attention(
             self.config, self.dtypes, self.attn_impl, self.mesh, self.chunked,
             self.row_frontier, self.fused_qkv, self.quantized, self.kv_quant,
-            name="attn",
+            self.paged, name="attn",
         )(
             RMSNorm(self.config.rms_norm_eps, self.dtypes, name="input_norm")(h),
-            kv, layer, kv_start, kv_len, cos, sin, write_index,
+            kv, layer, kv_start, kv_len, cos, sin, write_index, block_tables,
         )
         h = h + attn_out
         h = h + MLP(
@@ -637,6 +796,7 @@ class LlamaModel(nn.Module):
     fused_qkv: bool = False  # see Attention.fused_qkv (tp=1 fused projections)
     quantized: bool = False  # see Attention.quantized (weight-only int8 serving)
     kv_quant: str = "bf16"  # see Attention.kv_quant (int8 KV cache)
+    paged: bool = False  # see Attention.paged (block-pool KV arena)
 
     @nn.compact
     def __call__(
@@ -649,6 +809,7 @@ class LlamaModel(nn.Module):
         write_index: jax.Array,
         last_logit_only: bool = False,
         logit_index: Optional[jax.Array] = None,
+        block_tables: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, KVCache]:
         c, dt = self.config, self.dtypes
         if self.quantized and c.tie_word_embeddings:
@@ -683,7 +844,8 @@ class LlamaModel(nn.Module):
             Block,
             variable_axes={"params": 0},
             split_rngs={"params": True},
-            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast,
+                     nn.broadcast, nn.broadcast),
             out_axes=0,
             length=c.num_layers,
         )
@@ -697,21 +859,28 @@ class LlamaModel(nn.Module):
             kv_in = (cache.k, cache.v)
         (h, new_kv, _), _ = ScanBlocks(
             c, dt, self.attn_impl, self.mesh, self.chunked, self.row_frontier,
-            self.fused_qkv, self.quantized, self.kv_quant, name="layers",
+            self.fused_qkv, self.quantized, self.kv_quant, self.paged,
+            name="layers",
         )(
-            (h, kv_in, jnp.int32(0)), kv_start, kv_len, cos, sin, write_index
+            (h, kv_in, jnp.int32(0)), kv_start, kv_len, cos, sin, write_index,
+            block_tables,
         )
         new_cache = KVCache(*new_kv)
 
         h = RMSNorm(c.rms_norm_eps, dt, name="final_norm")(h)
         if logit_index is not None:
-            # right-padded prefill (prefix-cache suffix chunks): the LAST
-            # REAL token sits at a dynamic position, not -1 — slice just it
-            # before the head projection (same [B, S, V] avoidance as
-            # last_logit_only, but at a traced index)
+            # right-padded prefill (prefix-cache suffix chunks; the paged
+            # engine's whole-prompt prefill): the LAST REAL token sits at a
+            # dynamic position, not -1 — slice just it before the head
+            # projection (same [B, S, V] avoidance as last_logit_only, but
+            # at a traced index). A VECTOR index gathers per row — paged
+            # admission groups rows of different real lengths in one bucket.
             B = h.shape[0]
             idx = jnp.clip(jnp.asarray(logit_index, jnp.int32), 0, h.shape[1] - 1)
-            h = jax.lax.dynamic_slice(h, (0, idx, 0), (B, 1, h.shape[2]))
+            if idx.ndim == 0:
+                h = jax.lax.dynamic_slice(h, (0, idx, 0), (B, 1, h.shape[2]))
+            else:
+                h = jnp.take_along_axis(h, idx.reshape(B, 1, 1), axis=1)
         elif last_logit_only:
             # prefill only consumes the final position — projecting just it
             # avoids a [B, S, V] fp32 intermediate (S x the FLOPs and HBM)

@@ -68,6 +68,9 @@ _RULES: Tuple[Tuple[re.Pattern, str], ...] = tuple(
         # -- higher is better ---------------------------------------------
         (r"tok_per_s|tokens_per_sec|per_s$|_per_s(\.|_|$)|qps", "higher"),
         (r"mfu|vs_baseline|tokens_per_verify|reduction", "higher"),
+        # paged-KV leg: dense→paged step-rate ratio and the
+        # admittable-slots-at-fixed-HBM gain (ISSUE 5 acceptance numbers)
+        (r"speedup|_gain$", "higher"),
         # -- lower is better ----------------------------------------------
         (r"_ms($|\.|_)|_s$|seconds|_bytes$", "lower"),
     )

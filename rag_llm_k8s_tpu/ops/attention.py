@@ -968,6 +968,605 @@ def chunk_prefill_attention_q8(
     return out.reshape(B, H, S, hd).transpose(0, 2, 1, 3)
 
 
+# ---------------------------------------------------------------------------
+# paged KV cache (block-pool arena + per-row block tables)
+# ---------------------------------------------------------------------------
+#
+# The dense decode kernels above stream a [L, B, K, T, hd] cache whose T is
+# the engine's FULL window for every row — at B=64 that is mostly pad (a
+# 300-token prompt in a 4352-slot row), and the bandwidth-bound decode step
+# pays for every byte of it. The paged layout replaces the per-row T axis
+# with a POOL of fixed-size blocks, [L, N, K, bs, hd], plus a per-row int32
+# block table mapping logical block j of row b to a physical pool block.
+# The kernels below are the dense kernels with ONE change: the K/V block
+# index map reads the table (scalar prefetch, SMEM) instead of computing
+# kj directly — the flash recurrence, masking, and out-of-window block skip
+# are identical, and only a row's LIVE blocks are ever streamed, so decode
+# bandwidth scales with real tokens, not the window.
+#
+# Geometry: paged rows are RIGHT-padded — logical positions start at 0, the
+# valid window is [0, kv_len), and kv_start does not exist (this is also
+# what makes prefix blocks shareable: a shared prompt head always occupies
+# logical blocks 0..n at identical in-block offsets). Table entries for
+# blocks a row has not reached point at the reserved null block 0
+# (engine/kv_pool.py): the index map may prefetch it, but the block-skip
+# predicate (kj * bs >= kv_len) guarantees it is never computed on.
+
+
+def _paged_decode_kernel(
+    layer_ref,  # SMEM [1] (consumed by the index maps)
+    tables_ref,  # SMEM [B * MB]: flattened block tables (index maps)
+    kv_len_ref,  # SMEM [B]: valid logical frontier (exclusive)
+    q_ref,  # [1, K, G, hd]
+    k_ref,  # [1, 1, K, bs, hd] — the PHYSICAL block the table named
+    v_ref,  # [1, 1, K, bs, hd]
+    o_ref,  # [1, K, G, hd]
+    m_scr,  # VMEM [K, G, 1]
+    l_scr,  # VMEM [K, G, 1]
+    acc_scr,  # VMEM [K, G, hd]
+    *,
+    bs: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    kj = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # logical block skip: blocks at/after the frontier were never allocated
+    # (their table entries are the null block) — no work, no reads counted
+    blk_lo = kj * bs
+    live = blk_lo < kv_len_ref[b]
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]  # [K, G, hd]
+        k = k_ref[0, 0]  # [K, bs, hd]
+        v = v_ref[0, 0]
+        # zero K/V rows past the frontier BEFORE any matmul: the frontier
+        # block's tail slots may be uninitialized device memory, and a NaN
+        # there survives even a zero-weight product (0 * NaN = NaN)
+        rpos = blk_lo + jax.lax.broadcasted_iota(
+            jnp.int32, (k.shape[0], k.shape[1], 1), 1
+        )
+        rok = rpos < kv_len_ref[b]
+        k = jnp.where(rok, k, 0)
+        v = jnp.where(rok, v, 0)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale  # [K, G, bs]
+
+        k_pos = blk_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        ok = k_pos < kv_len_ref[b]
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(ok, jnp.exp(s - m_new), 0.0)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=2, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention(
+    q: jax.Array,  # [B, 1, H, hd] — the single fresh query token
+    k_arena: jax.Array,  # [L, N, K, bs, hd] — the block-pool arena
+    v_arena: jax.Array,  # [L, N, K, bs, hd]
+    block_tables: jax.Array,  # [B, MB] int32: logical block -> physical block
+    kv_len: jax.Array,  # [B] int32: valid logical frontier (exclusive)
+    layer: jax.Array,  # [] or [1] int32
+    interpret: bool = False,
+) -> jax.Array:
+    """``decode_attention`` over a paged arena: one grid cell per (row,
+    logical block), the physical block resolved by the row's table inside
+    the block index map (scalar prefetch — the table never leaves SMEM).
+    Streaming layout, flash recurrence, and masking match the dense kernel;
+    the only difference is WHICH ``(bs, hd)`` slabs get DMA'd."""
+    B, S, H, hd = q.shape
+    assert S == 1, f"paged_decode_attention is single-token (got S={S})"
+    L, N, K, bs, _ = k_arena.shape
+    G = H // K
+    MB = block_tables.shape[1]
+    if not interpret and bs % 16:
+        raise ValueError(
+            f"paged block_size={bs} must be a multiple of the Mosaic 16-row "
+            "bf16 tile (EngineConfig.kv_block_size)"
+        )
+
+    qh = q.reshape(B, K, G, hd)
+    grid = (B, MB)
+
+    def kv_index(b, kj, layer_ref, tables_ref, *s_):
+        return (layer_ref[0], tables_ref[b * MB + kj], 0, 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, bs=bs, scale=hd**-0.5),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, K, G, hd), lambda b, kj, *s_: (b, 0, 0, 0)),
+                pl.BlockSpec((1, 1, K, bs, hd), kv_index),
+                pl.BlockSpec((1, 1, K, bs, hd), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, K, G, hd), lambda b, kj, *s_: (b, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((K, G, 1), jnp.float32),
+                pltpu.VMEM((K, G, 1), jnp.float32),
+                pltpu.VMEM((K, G, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, K, G, hd), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        block_tables.astype(jnp.int32).reshape(-1),
+        kv_len.astype(jnp.int32),
+        qh,
+        k_arena,
+        v_arena,
+    )
+
+    return out.reshape(B, 1, H, hd)
+
+
+def _paged_decode_kernel_q8(
+    layer_ref,  # SMEM [1]
+    tables_ref,  # SMEM [B * MB]
+    kv_len_ref,  # SMEM [B]
+    q_ref,  # [1, K, G, hd]
+    k_ref,  # [1, 1, K, bs, hd] int8
+    v_ref,  # [1, 1, K, bs, hd] int8
+    ks_ref,  # [1, 1, K, bs] fp32
+    vs_ref,  # [1, 1, K, bs] fp32
+    o_ref,  # [1, K, G, hd]
+    m_scr,  # VMEM [K, G, 1]
+    l_scr,  # VMEM [K, G, 1]
+    acc_scr,  # VMEM [K, G, hd]
+    *,
+    bs: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    kj = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    blk_lo = kj * bs
+    live = blk_lo < kv_len_ref[b]
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]  # [K, G, hd]
+        # int8 payloads need NO validity masking (every bit pattern is
+        # finite); invalid columns die via the score mask + zeroed scales,
+        # dequantization rides the epilogues exactly as in _decode_kernel_q8
+        k = k_ref[0, 0].astype(q.dtype)  # [K, bs, hd]
+        rpos = blk_lo + jax.lax.broadcasted_iota(jnp.int32, (k.shape[0], bs), 1)
+        rok = rpos < kv_len_ref[b]
+        # scales CAN be NaN past the frontier (uninitialized fp32 memory)
+        ks = jnp.where(rok, ks_ref[0, 0], 0.0)
+        vs = jnp.where(rok, vs_ref[0, 0], 0.0)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * scale * ks[:, None, :]
+
+        k_pos = blk_lo + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        ok = k_pos < kv_len_ref[b]
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(ok, jnp.exp(s - m_new), 0.0)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=2, keepdims=True)
+        pv = (p * vs[:, None, :]).astype(q.dtype)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            pv, v_ref[0, 0].astype(q.dtype), (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_q8(
+    q: jax.Array,  # [B, 1, H, hd]
+    k_arena: jax.Array,  # [L, N, K, bs, hd] int8
+    v_arena: jax.Array,  # [L, N, K, bs, hd] int8
+    k_scale: jax.Array,  # [L, N, K, bs] fp32
+    v_scale: jax.Array,  # [L, N, K, bs] fp32
+    block_tables: jax.Array,  # [B, MB] int32
+    kv_len: jax.Array,  # [B] int32
+    layer: jax.Array,  # [] or [1] int32
+    interpret: bool = False,
+) -> jax.Array:
+    """``paged_decode_attention`` over an int8 arena: the table indirection
+    of the paged kernel + the epilogue dequantization of the q8 kernel."""
+    B, S, H, hd = q.shape
+    assert S == 1, f"paged_decode_attention_q8 is single-token (got S={S})"
+    L, N, K, bs, _ = k_arena.shape
+    G = H // K
+    MB = block_tables.shape[1]
+    if not interpret and bs % 32:
+        # int8 blocks need a 32-row second-to-minor tile on real hardware
+        raise ValueError(
+            f"paged block_size={bs} must be a multiple of the Mosaic 32-row "
+            "int8 tile under kv_quant='int8' (EngineConfig.kv_block_size)"
+        )
+
+    qh = q.reshape(B, K, G, hd)
+    grid = (B, MB)
+
+    def kv_index(b, kj, layer_ref, tables_ref, *s_):
+        return (layer_ref[0], tables_ref[b * MB + kj], 0, 0, 0)
+
+    def sc_index(b, kj, layer_ref, tables_ref, *s_):
+        return (layer_ref[0], tables_ref[b * MB + kj], 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel_q8, bs=bs, scale=hd**-0.5),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, K, G, hd), lambda b, kj, *s_: (b, 0, 0, 0)),
+                pl.BlockSpec((1, 1, K, bs, hd), kv_index),
+                pl.BlockSpec((1, 1, K, bs, hd), kv_index),
+                pl.BlockSpec((1, 1, K, bs), sc_index),
+                pl.BlockSpec((1, 1, K, bs), sc_index),
+            ],
+            out_specs=pl.BlockSpec((1, K, G, hd), lambda b, kj, *s_: (b, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((K, G, 1), jnp.float32),
+                pltpu.VMEM((K, G, 1), jnp.float32),
+                pltpu.VMEM((K, G, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, K, G, hd), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        block_tables.astype(jnp.int32).reshape(-1),
+        kv_len.astype(jnp.int32),
+        qh,
+        k_arena,
+        v_arena,
+        k_scale,
+        v_scale,
+    )
+
+    return out.reshape(B, 1, H, hd)
+
+
+def _paged_chunk_kernel(
+    layer_ref,  # SMEM [1]
+    wi_ref,  # SMEM [B]: per-row logical slot of query 0
+    tables_ref,  # SMEM [B * MB]
+    kv_len_ref,  # SMEM [B]
+    q_ref,  # [1, bq, hd]
+    k_ref,  # [1, 1, 1, bs, hd]
+    v_ref,  # [1, 1, 1, bs, hd]
+    o_ref,  # [1, bq, hd]
+    m_scr,  # VMEM [bq, 1]
+    l_scr,  # VMEM [bq, 1]
+    acc_scr,  # VMEM [bq, hd]
+    *,
+    bq: int,
+    bs: int,
+    scale: float,
+    num_heads: int,
+):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+    b = bh // num_heads
+    wi = wi_ref[b]
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # block skip: logical blocks past the frontier or strictly above the
+    # OFFSET causal diagonal (query t sits at logical slot wi + t) do no work
+    q_hi = wi + qi * bq + bq - 1
+    live = (kj * bs < kv_len_ref[b]) & (kj * bs <= q_hi)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0, 0, 0]
+        v = v_ref[0, 0, 0]
+        # zero K/V rows past the frontier BEFORE any matmul (frontier-block
+        # tail slots may be uninitialized; 0 * NaN = NaN)
+        cpos = kj * bs + jax.lax.broadcasted_iota(jnp.int32, (bs, 1), 0)
+        cok = cpos < kv_len_ref[b]
+        k = jnp.where(cok, k, 0)
+        v = jnp.where(cok, v, 0)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bs]
+
+        q_pos = wi + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bs), 0)
+        k_pos = kj * bs + jax.lax.broadcasted_iota(jnp.int32, (bq, bs), 1)
+        ok = (k_pos < kv_len_ref[b]) & (k_pos <= q_pos)
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(ok, jnp.exp(s - m_new), 0.0)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bq", "interpret"))
+def paged_chunk_attention(
+    q: jax.Array,  # [B, S, H, hd] — one prompt chunk's fresh queries
+    k_arena: jax.Array,  # [L, N, K, bs, hd]
+    v_arena: jax.Array,  # [L, N, K, bs, hd]
+    block_tables: jax.Array,  # [B, MB] int32
+    kv_len: jax.Array,  # [B] int32: valid frontier (= write_index + chunk len)
+    layer: jax.Array,  # [] or [1] int32
+    write_index: jax.Array,  # [B] int32: per-row logical slot of query 0
+    bq: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """``chunk_prefill_attention`` over a paged arena (the paged
+    chunked-prefill path): each query block streams its row's LIVE blocks
+    via the table with offset causality. The chunk's own K/V must already
+    be scattered into the row's blocks (the model writes before attending,
+    exactly like the dense chunk path). ``write_index`` is per-row — paged
+    rows are right-padded, so rows at different depths chunk together."""
+    B, S, H, hd = q.shape
+    L, N, K, bs, _ = k_arena.shape
+    G = H // K
+    MB = block_tables.shape[1]
+    bq = _fit_block(S, bq)
+    if not interpret and bs % 16:
+        raise ValueError(
+            f"paged block_size={bs} must be a multiple of the Mosaic 16-row "
+            "bf16 tile (EngineConfig.kv_block_size)"
+        )
+
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, S, hd)
+    grid = (B * H, S // bq, MB)
+
+    def kv_index(bh, qi, kj, layer_ref, wi_ref, tables_ref, *s_):
+        return (
+            layer_ref[0],
+            tables_ref[(bh // H) * MB + kj],
+            (bh % H) // G,
+            0,
+            0,
+        )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_chunk_kernel, bq=bq, bs=bs, scale=hd**-0.5, num_heads=H
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, hd), lambda bh, qi, kj, *s_: (bh, qi, 0)),
+                pl.BlockSpec((1, 1, 1, bs, hd), kv_index),
+                pl.BlockSpec((1, 1, 1, bs, hd), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, bq, hd), lambda bh, qi, kj, *s_: (bh, qi, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, hd), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        jnp.broadcast_to(jnp.asarray(write_index, jnp.int32), (B,)),
+        block_tables.astype(jnp.int32).reshape(-1),
+        kv_len.astype(jnp.int32),
+        qt,
+        k_arena,
+        v_arena,
+    )
+
+    return out.reshape(B, H, S, hd).transpose(0, 2, 1, 3)
+
+
+def _gather_paged_layer(
+    arena: jax.Array,  # [L, N, K, bs, hd] (or [L, N, K, bs] for scales)
+    block_tables: jax.Array,  # [B, MB] int32
+    layer: jax.Array,  # [] or [1] int32
+) -> jax.Array:
+    """``[B, K, MB*bs(, hd)]`` logical view of ONE layer, assembled by
+    gathering each row's blocks — the shared helper of the XLA oracles (a
+    per-layer gather is MBs; CPU tests and the q8 chunk fallback use it,
+    the Pallas kernels never materialize it)."""
+    lay = jnp.asarray(layer, jnp.int32).reshape(())
+    al = jax.lax.dynamic_index_in_dim(arena, lay, 0, keepdims=False)
+    g = jnp.take(al, block_tables, axis=0)  # [B, MB, K, bs(, hd)]
+    if g.ndim == 5:
+        B, MB, K, bs, hd = g.shape
+        return g.transpose(0, 2, 1, 3, 4).reshape(B, K, MB * bs, hd)
+    B, MB, K, bs = g.shape
+    return g.transpose(0, 2, 1, 3).reshape(B, K, MB * bs)
+
+
+def paged_decode_attention_xla(
+    q: jax.Array,  # [B, 1, H, hd]
+    k_arena: jax.Array,  # [L, N, K, bs, hd]
+    v_arena: jax.Array,  # [L, N, K, bs, hd]
+    block_tables: jax.Array,  # [B, MB]
+    kv_len: jax.Array,  # [B]
+    layer: jax.Array,  # [] or [1] int32
+) -> jax.Array:
+    """Dense XLA reference for ``paged_decode_attention`` (oracle; fallback
+    off-TPU): gather each row's blocks into a logical [B, K, T', hd] view,
+    then the dense decode math over the [0, kv_len) window. Gathered slots
+    past the frontier zero out first — they can be null-block junk (and in
+    tests deliberately NaN), and 0 * NaN = NaN survives the prob mask."""
+    k = _zero_invalid(_gather_paged_layer(k_arena, block_tables, layer), kv_len)[None]
+    v = _zero_invalid(_gather_paged_layer(v_arena, block_tables, layer), kv_len)[None]
+    B = q.shape[0]
+    zero = jnp.zeros((B,), jnp.int32)
+    return decode_attention_xla(q, k, v, zero, kv_len, jnp.int32(0))
+
+
+def _zero_invalid(x: jax.Array, kv_len: jax.Array) -> jax.Array:
+    """Zero logical slots >= kv_len of a gathered ``[B, K, T'(, hd)]``
+    view (the oracle-side mirror of the kernels' pre-matmul zeroing)."""
+    T = x.shape[2]
+    ok = jnp.arange(T)[None, None, :] < kv_len[:, None, None]
+    if x.ndim == 4:
+        ok = ok[..., None]
+    return jnp.where(ok, x, 0)
+
+
+def paged_decode_attention_xla_q8(
+    q: jax.Array,  # [B, 1, H, hd]
+    k_arena: jax.Array,  # [L, N, K, bs, hd] int8
+    v_arena: jax.Array,  # [L, N, K, bs, hd] int8
+    k_scale: jax.Array,  # [L, N, K, bs] fp32
+    v_scale: jax.Array,  # [L, N, K, bs] fp32
+    block_tables: jax.Array,  # [B, MB]
+    kv_len: jax.Array,  # [B]
+    layer: jax.Array,  # [] or [1] int32
+) -> jax.Array:
+    """Dense XLA reference for ``paged_decode_attention_q8``: gather +
+    window-masked dequant of this layer's blocks, then the bf16 oracle."""
+    kd, vd = _dequant_paged_layer(
+        k_arena, v_arena, k_scale, v_scale, block_tables, kv_len, layer, q.dtype
+    )
+    B = q.shape[0]
+    zero = jnp.zeros((B,), jnp.int32)
+    return decode_attention_xla(q, kd, vd, zero, kv_len, jnp.int32(0))
+
+
+def _dequant_paged_layer(
+    k_arena, v_arena, k_scale, v_scale, block_tables, kv_len, layer, dtype
+):
+    """Gathered, dequantized ``[1, B, K, T', hd]`` K/V views of one layer
+    of an int8 arena. Scales past the frontier zero out under the window
+    mask (they can be uninitialized fp32 = NaN; the int8 payload is finite
+    by construction), so invalid slots contribute exactly 0."""
+    k = _gather_paged_layer(k_arena, block_tables, layer)
+    v = _gather_paged_layer(v_arena, block_tables, layer)
+    ks = _gather_paged_layer(k_scale, block_tables, layer)
+    vs = _gather_paged_layer(v_scale, block_tables, layer)
+    T = k.shape[2]
+    t_ok = jnp.arange(T)[None, None, :] < kv_len[:, None, None]  # [B, 1, T]
+    ks = jnp.where(t_ok, ks, 0.0)
+    vs = jnp.where(t_ok, vs, 0.0)
+    kd = (k.astype(jnp.float32) * ks[..., None]).astype(dtype)[None]
+    vd = (v.astype(jnp.float32) * vs[..., None]).astype(dtype)[None]
+    return kd, vd
+
+
+def paged_chunk_attention_xla(
+    q: jax.Array,  # [B, S, H, hd]
+    k_arena: jax.Array,  # [L, N, K, bs, hd]
+    v_arena: jax.Array,  # [L, N, K, bs, hd]
+    block_tables: jax.Array,  # [B, MB]
+    kv_len: jax.Array,  # [B]
+    layer: jax.Array,  # [] or [1] int32
+    write_index: jax.Array,  # [B] int32: per-row logical slot of query 0
+) -> jax.Array:
+    """Dense XLA reference for ``paged_chunk_attention`` (oracle; fallback
+    off-TPU). Offset causality is PER-ROW (``write_index`` is a vector —
+    paged rows are right-padded and chunk at their own depths)."""
+    k = _zero_invalid(_gather_paged_layer(k_arena, block_tables, layer), kv_len)[None]
+    v = _zero_invalid(_gather_paged_layer(v_arena, block_tables, layer), kv_len)[None]
+    return _paged_chunk_on_views(q, k, v, kv_len, write_index)
+
+
+def paged_chunk_attention_xla_q8(
+    q: jax.Array,  # [B, S, H, hd]
+    k_arena: jax.Array,  # [L, N, K, bs, hd] int8
+    v_arena: jax.Array,  # [L, N, K, bs, hd] int8
+    k_scale: jax.Array,  # [L, N, K, bs] fp32
+    v_scale: jax.Array,  # [L, N, K, bs] fp32
+    block_tables: jax.Array,  # [B, MB]
+    kv_len: jax.Array,  # [B]
+    layer: jax.Array,  # [] or [1] int32
+    write_index: jax.Array,  # [B] int32
+) -> jax.Array:
+    """Reference (and the serving fallback under int8-KV) for the paged
+    chunked-prefill path: gather + dequantize ONE layer's blocks, then the
+    bf16 oracle. Chunked prefill is a per-admission cost — the steady-state
+    bandwidth the int8 arena buys lives in the decode kernel, which stays
+    fully paged+fused; a dedicated q8 paged chunk kernel can land later
+    without touching callers."""
+    kd, vd = _dequant_paged_layer(
+        k_arena, v_arena, k_scale, v_scale, block_tables, kv_len, layer, q.dtype
+    )
+    return _paged_chunk_on_views(q, kd, vd, kv_len, write_index)
+
+
+def _paged_chunk_on_views(q, kd, vd, kv_len, write_index):
+    """Offset-causal attention over already-gathered [1, B, K, T, hd]
+    views (the q8 oracle's tail — shares the masking math above)."""
+    B, S, H, hd = q.shape
+    K = kd.shape[2]
+    G = H // K
+    k = kd[0]
+    v = vd[0]
+    T = k.shape[2]
+    qg = q.reshape(B, S, K, G, hd)
+    s = jnp.einsum("bqkgd,bktd->bkgqt", qg, k, preferred_element_type=jnp.float32)
+    s = s * (hd**-0.5)
+    wi = jnp.broadcast_to(jnp.asarray(write_index, jnp.int32), (B,))
+    q_pos = wi[:, None] + jnp.arange(S)[None, :]
+    t_pos = jnp.arange(T)
+    ok = t_pos[None, None, :] < kv_len[:, None, None]
+    ok = ok & (t_pos[None, None, :] <= q_pos[:, :, None])
+    s = jnp.where(ok[:, None, None, :, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(ok[:, None, None, :, :], p, 0.0)
+    o = jnp.einsum(
+        "bkgqt,bktd->bqkgd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return o.reshape(B, S, H, hd).astype(q.dtype)
+
+
 def chunk_attention_xla_q8(
     q: jax.Array,  # [B, S, H, hd]
     k_cache: jax.Array,  # [L, B, K, T, hd] int8

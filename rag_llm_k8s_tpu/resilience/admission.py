@@ -69,6 +69,15 @@ class AdmissionController:
         # None keeps the gate standalone
         self.reject_counter = None
         self.deadline_counter = None
+        # set by the service when the engine serves from a paged KV pool
+        # (engine/kv_pool.py): a callable returning True while the pool has
+        # ZERO free blocks. While saturated, a request that would have to
+        # WAIT is shed immediately with 429 reason="pool_exhausted" —
+        # queueing behind a pool that cannot grow only converts the
+        # client's retry budget into server-side latency. Requests under
+        # the concurrency cap still run: decode frees blocks every window,
+        # and the scheduler's own backpressure orders them correctly.
+        self.saturation_hint = None
 
     # -- internals -------------------------------------------------------
     def _reject(self, reason: str, status: int, retry_after_s: float):
@@ -92,6 +101,9 @@ class AdmissionController:
                 return
             if self.waiting >= self.max_queue:
                 self._reject("queue_full", 429, self.retry_after_s)
+            hint = self.saturation_hint
+            if hint is not None and hint():
+                self._reject("pool_exhausted", 429, self.retry_after_s)
             self.waiting += 1
             try:
                 while self.active >= self.max_concurrency:

@@ -103,6 +103,19 @@ def make_segment_source(llm_tokenizer, max_bucket: int):
     return segment_ids
 
 
+class _FanoutHistogram:
+    """One observation into several histogram children (the fused retrieve
+    dispatch is simultaneously the embed dispatch — both stage views get
+    the same per-request coalesce wait)."""
+
+    def __init__(self, *hists):
+        self._hists = hists
+
+    def observe(self, value: float) -> None:
+        for h in self._hists:
+            h.observe(value)
+
+
 class RagService:
     """The retrieve-then-generate pipeline behind the routes."""
 
@@ -160,10 +173,16 @@ class RagService:
         self._fused_retrieve: Dict[tuple, object] = {}
         # concurrent serving: coalesce the embed+kNN stage too — without
         # this, N concurrent queries serialize N fused-retrieve device calls
-        # ahead of the (already coalesced) generate stage
+        # ahead of the (already coalesced) generate stage. UNCONDITIONAL
+        # since the paged-KV round: schedulerless serving (the one-shot
+        # engine without a BatchScheduler) used to dispatch one encoder
+        # forward per concurrent /generate, and BENCH_r05 measured that
+        # contention as embed_retrieve 6 ms solo → 170 ms sustained — the
+        # query-path embeds now always ride the coalescer's batched
+        # EncoderRunner dispatch, and each request's enqueue→dispatch wait
+        # is visible as rag_coalesce_wait_seconds{stage="embed"}.
         self._retrieve_cap = 8
-        self.retrieve_coalescer = None
-        if scheduler is not None:
+        if encoder is not None:
             from rag_llm_k8s_tpu.engine.batching import Coalescer
 
             # 25 ms window: a COLD burst's requests arrive within ~ms of each
@@ -179,14 +198,28 @@ class RagService:
                 max_batch=self._retrieve_cap, max_wait_ms=25.0,
                 pending_hint=lambda: self._inflight_retrieve,
             )
-            self.retrieve_coalescer.wait_histogram = (
-                self._m_coalesce_wait.labels(stage="retrieve")
+            # the fused retrieve IS the embed dispatch: one wait sample
+            # feeds both stage views (retrieve keeps continuity with older
+            # dashboards; embed is the encoder-contention panel)
+            self.retrieve_coalescer.wait_histogram = _FanoutHistogram(
+                self._m_coalesce_wait.labels(stage="retrieve"),
+                self._m_coalesce_wait.labels(stage="embed"),
             )
             self.retrieve_coalescer.join_timeout_counter = self._m_join_timeouts
+        else:
+            self.retrieve_coalescer = None
+        if scheduler is not None:
             if getattr(scheduler, "pending_hint", False) is None:
                 # the generate scheduler is constructed by the caller; give
                 # it the same early-exit hint unless the caller set its own
                 scheduler.pending_hint = lambda: self._inflight_generate
+        # paged-KV backpressure (engine/kv_pool.py): while the scheduler
+        # engine's pool has zero free blocks, the admission gate sheds
+        # would-be-queued requests with 429 reason="pool_exhausted" instead
+        # of stacking them behind a device that cannot grow
+        pool = getattr(getattr(scheduler, "engine", None), "kv_pool", None)
+        if pool is not None:
+            self.admission.saturation_hint = lambda: pool.available() == 0
         # ONE EOS policy for ingest and query truncation alike: default the
         # runner's eos from the tokenizer so the two paths cannot diverge
         if encoder is not None and getattr(encoder, "eos_id", None) is None:
@@ -229,7 +262,7 @@ class RagService:
             "rag_coalesce_wait_seconds",
             "enqueue-to-dispatch wait in the coalescing stages (stage label)",
         )
-        for s in ("retrieve", "generate"):
+        for s in ("retrieve", "embed", "generate"):
             self._m_coalesce_wait.labels(stage=s)
         # present in every mode so dashboards stay uniform; only the
         # continuous engine's host loop can actually observe it (exact
@@ -292,9 +325,9 @@ class RagService:
         self._m_adm_rejected = reg.labeled_counter(
             "rag_admission_rejected_total",
             "requests shed at the admission gate (reason: queue_full | "
-            "breaker_open)",
+            "breaker_open | pool_exhausted)",
         )
-        for r in ("queue_full", "breaker_open"):
+        for r in ("queue_full", "breaker_open", "pool_exhausted"):
             self._m_adm_rejected.labels(reason=r)
         self.admission.reject_counter = self._m_adm_rejected
         self._m_deadline = reg.labeled_counter(
@@ -870,11 +903,12 @@ class RagService:
             self._deadline_check(deadline, "assemble")
 
             t0 = time.monotonic()
+            gen_info: Dict[str, float] = {}
             with tracing.span("generate"):
                 if self.scheduler is not None and len(prompt_ids) <= self._scheduler_prompt_cap():
                     try:
                         out_ids = self.scheduler.submit(
-                            prompt_ids, deadline=deadline
+                            prompt_ids, deadline=deadline, info=gen_info
                         )
                     except DeadlineExceeded as e:
                         # worker-side expiries (queue wait, mid-decode
@@ -911,6 +945,12 @@ class RagService:
                 completion = self.llm_tokenizer.decode(out_ids)
             timings["_detokenize_s"] = time.monotonic() - t_de
             timings["generate_ms"] = (time.monotonic() - t0) * 1e3
+            if "kv_blocks_allocated" in gen_info:
+                # paged KV: the row's peak block footprint (per-request HBM
+                # accounting next to the pool gauges)
+                timings["kv_blocks_allocated"] = float(
+                    gen_info["kv_blocks_allocated"]
+                )
             timings["total_ms"] = (time.monotonic() - t_all) * 1e3
         finally:
             # error paths (and the no-results return) must release their

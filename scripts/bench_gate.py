@@ -37,6 +37,17 @@ sys.path.insert(0, REPO)
 
 from rag_llm_k8s_tpu.obs import regression  # noqa: E402
 
+# Metrics that may NEVER silently vanish from a judged bench document: a
+# dropped leg reads as "no regression" under the default missing-is-info
+# policy, which is exactly how the B=64 continuous-step collapse went
+# unjudged for a round. Keys here fail the gate when the CURRENT document
+# lacks them while the baseline has them — unless the current run was
+# budget-truncated before that leg (truncation is already reported).
+# b64_sync16 is tracked higher-is-better by regression.classify.
+REQUIRED_KEYS = (
+    "continuous_device_steps_per_s.b64_sync16",
+)
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
@@ -50,6 +61,11 @@ def main(argv=None) -> int:
                          f"(default {regression.DEFAULT_TOLERANCE})")
     ap.add_argument("--strict", action="store_true",
                     help="also fail on metrics missing from --current")
+    ap.add_argument("--require", action="append", default=None,
+                    metavar="KEY",
+                    help="flattened metric key(s) the CURRENT document must "
+                         "carry (repeatable); overrides the built-in "
+                         "REQUIRED_KEYS list")
     ap.add_argument("--dry-run", action="store_true",
                     help="schema check only (no value judgment, no TPU)")
     args = ap.parse_args(argv)
@@ -107,6 +123,21 @@ def main(argv=None) -> int:
         print(f"bench-gate: REGRESSION   {f.describe()}", file=sys.stderr)
 
     failed = bool(findings["regression"])
+    cur_flat = regression.flatten(current)
+    base_flat = regression.flatten(baseline)
+    for key in (args.require if args.require is not None else REQUIRED_KEYS):
+        if key in cur_flat or key not in base_flat:
+            continue  # present, or the baseline never had it either
+        if current.get("truncated"):
+            # budget truncation already printed its NOTE; a leg the budget
+            # cut is not a SILENT drop
+            print(f"bench-gate: required {key} absent (budget-truncated run)")
+            continue
+        print(
+            f"bench-gate: REQUIRED metric {key} missing from current — a "
+            "dropped leg must never read as a pass", file=sys.stderr,
+        )
+        failed = True
     if args.strict and any(f.current is None for f in findings["missing"]):
         print("bench-gate: strict: metrics missing from current", file=sys.stderr)
         failed = True

@@ -1,0 +1,557 @@
+"""Paged KV cache (ISSUE 5): allocator semantics, paged↔dense greedy
+parity, kernel↔oracle parity, block accounting under eviction / reset /
+preemption, and block-granular prefix reuse.
+
+The load-bearing contract is BYTE-IDENTICAL greedy token streams between
+the paged and dense continuous engines on mixed-length batches — the paged
+layout changes WHERE KV lives (pool blocks via per-row tables, right-padded
+logical positions) but not a single attended value. Every other test here
+is bookkeeping: blocks must flow back to the free list on every exit path
+(retire, first-token EOS, eviction, preemption, EngineStateLost reset), or
+the pool leaks toward permanent backpressure.
+"""
+
+import dataclasses
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rag_llm_k8s_tpu.core.config import (
+    DTypePolicy,
+    EngineConfig,
+    LlamaConfig,
+    PrefixCacheConfig,
+    SamplingConfig,
+)
+from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine, ContinuousScheduler
+from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+from rag_llm_k8s_tpu.engine.kv_pool import KVBlockPool, NULL_BLOCK, PoolExhausted
+from rag_llm_k8s_tpu.models.llama import init_llama_params
+
+FP32 = DTypePolicy.fp32()
+GREEDY = SamplingConfig(do_sample=False, max_new_tokens=8)
+ENG = EngineConfig(prompt_buckets=(16, 32), max_batch_size=4, max_seq_len=64)
+PAGED = dataclasses.replace(ENG, kv_paged=True, kv_block_size=16)
+PROMPTS = [[3, 17, 42, 7, 99], [5, 5, 8], [11] * 12, [2, 9]]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = LlamaConfig.tiny()
+    params = init_llama_params(jax.random.PRNGKey(0), cfg, FP32)
+    oracle = InferenceEngine(
+        cfg, params, sampling=GREEDY, engine_config=ENG, dtypes=FP32
+    )
+    return cfg, params, oracle
+
+
+def paged_engine(cfg, params, eng_cfg=PAGED, sampling=GREEDY):
+    return ContinuousEngine(
+        cfg, params, sampling=sampling, engine_config=eng_cfg, dtypes=FP32
+    )
+
+
+def drain(eng, reqs):
+    """admit_many + step-to-completion → {rid: tokens}."""
+    results = {}
+    outs = eng.admit_many([(rid, p, mn, None) for rid, p, mn in reqs])
+    for (rid, _, _), res in zip(reqs, outs):
+        if isinstance(res, BaseException):
+            raise res
+        _, fin = res
+        if fin is not None:
+            results[rid] = fin
+    for _ in range(300):
+        for rid, toks in eng.step():
+            results[rid] = toks
+        if not eng.has_active():
+            break
+    return results
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+
+class TestKVBlockPool:
+    def test_alloc_free_refcount(self):
+        pool = KVBlockPool(9, 16)  # 8 usable
+        assert pool.usable_blocks() == 8
+        ids = pool.alloc(3)
+        assert len(ids) == 3 and NULL_BLOCK not in ids
+        assert pool.blocks_in_use() == 3
+        pool.ref(ids[:1])
+        assert pool.free(ids) == 2  # the ref'd block survives
+        assert pool.blocks_in_use() == 1
+        assert pool.free(ids[:1]) == 1
+        assert pool.blocks_in_use() == 0
+
+    def test_alloc_is_all_or_nothing(self):
+        pool = KVBlockPool(5, 16)  # 4 usable
+        pool.alloc(3)
+        with pytest.raises(PoolExhausted):
+            pool.alloc(2)
+        assert pool.available() == 1  # the failed alloc took nothing
+
+    def test_double_free_and_foreign_ref_are_loud(self):
+        pool = KVBlockPool(5, 16)
+        (b,) = pool.alloc(1)
+        pool.free([b])
+        with pytest.raises(ValueError):
+            pool.free([b])
+        with pytest.raises(ValueError):
+            pool.ref([b])
+
+    def test_blocks_for_and_fragmentation(self):
+        pool = KVBlockPool(17, 16)
+        assert pool.blocks_for(1) == 1
+        assert pool.blocks_for(16) == 1
+        assert pool.blocks_for(17) == 2
+        pool.alloc(2)  # 32 slots
+        assert pool.fragmentation(24) == pytest.approx(0.25)
+        assert pool.fragmentation(0) == 1.0
+
+    def test_reset_reclaims_everything(self):
+        pool = KVBlockPool(9, 16)
+        ids = pool.alloc(5)
+        pool.ref(ids)  # even multiply-referenced blocks
+        pool.reset()
+        assert pool.blocks_in_use() == 0
+        assert len(pool.alloc(8)) == 8
+
+
+# ---------------------------------------------------------------------------
+# engine parity + accounting
+# ---------------------------------------------------------------------------
+
+
+class TestPagedDenseParity:
+    def test_mixed_length_greedy_parity(self, setup):
+        """THE acceptance contract: byte-identical greedy streams across
+        paged/dense on a mixed-length batch."""
+        cfg, params, oracle = setup
+        want = {i: oracle.generate([p])[0] for i, p in enumerate(PROMPTS)}
+        dense = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=ENG, dtypes=FP32
+        )
+        paged = paged_engine(cfg, params)
+        reqs = [(i, p, GREEDY.max_new_tokens) for i, p in enumerate(PROMPTS)]
+        assert drain(dense, reqs) == want
+        assert drain(paged, reqs) == want
+        assert paged.kv_pool.blocks_in_use() == 0  # all returned at retire
+
+    def test_mid_generation_admission_parity(self, setup):
+        cfg, params, oracle = setup
+        p1, p2 = PROMPTS[0], PROMPTS[1]
+        want1, want2 = oracle.generate([p1])[0], oracle.generate([p2])[0]
+        eng = paged_engine(cfg, params)
+        eng.admit(1, p1, GREEDY.max_new_tokens)
+        results = {}
+        for _ in range(3):
+            for rid, toks in eng.step():
+                results[rid] = toks
+        eng.admit(2, p2, GREEDY.max_new_tokens)  # joins mid-flight
+        while eng.has_active():
+            for rid, toks in eng.step():
+                results[rid] = toks
+        assert results == {1: want1, 2: want2}
+        assert eng.kv_pool.blocks_in_use() == 0
+
+    def test_multi_step_sync_parity(self, setup):
+        """k-step windows over the paged arena: same stream as dense k=1."""
+        cfg, params, oracle = setup
+        want = {i: oracle.generate([p])[0] for i, p in enumerate(PROMPTS)}
+        eng = paged_engine(
+            cfg, params, dataclasses.replace(PAGED, decode_sync_steps=4)
+        )
+        got = drain(eng, [(i, p, GREEDY.max_new_tokens) for i, p in enumerate(PROMPTS)])
+        assert got == want
+        assert eng.kv_pool.blocks_in_use() == 0
+
+    def test_int8_kv_paged_matches_dense(self, setup):
+        cfg, params, _ = setup
+        eng8 = dataclasses.replace(
+            ENG, prompt_buckets=(32,), kv_quant="int8"
+        )
+        paged8 = dataclasses.replace(
+            eng8, kv_paged=True, kv_block_size=32
+        )
+        reqs = [(i, p, 8) for i, p in enumerate(PROMPTS[:2])]
+        d = drain(ContinuousEngine(cfg, params, sampling=GREEDY,
+                                   engine_config=eng8, dtypes=FP32), reqs)
+        p = drain(paged_engine(cfg, params, paged8), reqs)
+        assert d == p
+
+    def test_seeded_sampling_layout_invariant(self, setup):
+        """Draws are (seed, position)-keyed: the cache layout must not
+        change what a seeded request samples."""
+        cfg, params, _ = setup
+        samp = SamplingConfig(do_sample=True, temperature=1.0, top_p=1.0,
+                              max_new_tokens=6)
+
+        def run(eng_cfg):
+            eng = ContinuousEngine(cfg, params, sampling=samp,
+                                   engine_config=eng_cfg, dtypes=FP32)
+            _, fin = eng.admit(1, [3, 17, 42, 7], 6, seed=123)
+            assert fin is None
+            out = {}
+            while eng.has_active():
+                for rid, toks in eng.step():
+                    out[rid] = toks
+            return out[1]
+
+        assert run(ENG) == run(PAGED)
+
+    def test_scheduler_end_to_end(self, setup):
+        cfg, params, oracle = setup
+        want = [oracle.generate([p])[0] for p in PROMPTS]
+        sched = ContinuousScheduler(paged_engine(cfg, params))
+        try:
+            outs = [None] * len(PROMPTS)
+
+            def run(i):
+                outs[i] = sched.submit(PROMPTS[i], timeout=120)
+
+            threads = [
+                threading.Thread(target=run, args=(i,))
+                for i in range(len(PROMPTS))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert outs == want
+            assert sched.engine.kv_pool.blocks_in_use() == 0
+        finally:
+            sched.shutdown()
+
+
+class TestPoolAccounting:
+    def test_eviction_returns_blocks(self, setup):
+        """Mid-decode eviction (the deadline path) frees the row's blocks
+        within the same call."""
+        cfg, params, _ = setup
+        eng = paged_engine(cfg, params)
+        eng.admit(1, PROMPTS[0], 8)
+        eng.step()
+        assert eng.kv_pool.blocks_in_use() > 0
+        assert eng.evict_requests([1]) != []
+        assert eng.kv_pool.blocks_in_use() == 0
+
+    def test_first_token_eos_releases_blocks(self, setup):
+        cfg, params, oracle = setup
+        first = oracle.generate([PROMPTS[0]], max_new_tokens=1)[0][0]
+        cfg_eos = dataclasses.replace(cfg, eos_token_ids=(first,))
+        eng = ContinuousEngine(cfg_eos, params, sampling=GREEDY,
+                               engine_config=PAGED, dtypes=FP32)
+        outs = eng.admit_many([(1, PROMPTS[0], 8, None)])
+        assert outs[0][1] == []  # finished at its very first token
+        assert eng.kv_pool.blocks_in_use() == 0
+
+    def test_reset_returns_every_block(self, setup):
+        """EngineStateLost recovery: reset() must hand EVERY block back —
+        a leak here compounds into permanent backpressure one fault at a
+        time (the chaos-lane twin lives in test_resilience.py)."""
+        cfg, params, oracle = setup
+        eng = paged_engine(cfg, params)
+        eng.admit(1, PROMPTS[2], 8)
+        eng.step()
+        assert eng.kv_pool.blocks_in_use() > 0
+        eng.reset()
+        assert eng.kv_pool.blocks_in_use() == 0
+        # and the engine still serves, correctly
+        want = oracle.generate([PROMPTS[1]])[0]
+        _, fin = eng.admit(2, PROMPTS[1], 8)
+        assert fin is None
+        results = {}
+        while eng.has_active():
+            for rid, toks in eng.step():
+                results[rid] = toks
+        assert results[2] == want
+        assert eng.kv_pool.blocks_in_use() == 0
+
+    def test_preemption_resumes_with_parity(self, setup):
+        """A pool sized for HALF the batch's decode growth forces mid-decode
+        preemption; the scheduler resubmits (prompt + emitted) and every
+        stream still matches the solo oracle, with zero leaked blocks."""
+        cfg, params, oracle = setup
+        want = [oracle.generate([p], max_new_tokens=40)[0] for p in PROMPTS]
+        tight = dataclasses.replace(PAGED, kv_pool_blocks=8)
+        eng = paged_engine(cfg, params, tight)
+        sched = ContinuousScheduler(eng)
+        try:
+            outs = [None] * len(PROMPTS)
+            errs = [None] * len(PROMPTS)
+
+            def run(i):
+                try:
+                    outs[i] = sched.submit(
+                        PROMPTS[i], max_new_tokens=40, timeout=300
+                    )
+                except BaseException as e:  # noqa: BLE001
+                    errs[i] = e
+
+            threads = [
+                threading.Thread(target=run, args=(i,))
+                for i in range(len(PROMPTS))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert errs == [None] * len(PROMPTS), errs
+            assert outs == want
+            assert eng.kv_pool.blocks_in_use() == 0
+        finally:
+            sched.shutdown()
+
+    def test_oversized_prompt_fails_never_hangs(self, setup):
+        """A prompt whose blocks outsize the whole pool fails with
+        PoolExhausted instead of queueing forever."""
+        cfg, params, _ = setup
+        eng = paged_engine(cfg, params, dataclasses.replace(PAGED, kv_pool_blocks=8))
+        assert eng.admission_state(16 * 9) == "never"
+        sched = ContinuousScheduler(eng)
+        try:
+            with pytest.raises(PoolExhausted):
+                sched.submit([7] * 30 * 5, timeout=60)  # > 8 blocks of 16
+        finally:
+            sched.shutdown()
+
+    def test_construction_validation(self, setup):
+        cfg, params, _ = setup
+        with pytest.raises(ValueError, match="at least one full row"):
+            paged_engine(cfg, params, dataclasses.replace(PAGED, kv_pool_blocks=2))
+        with pytest.raises(ValueError, match="Mosaic"):
+            paged_engine(cfg, params, dataclasses.replace(PAGED, kv_block_size=12))
+        with pytest.raises(ValueError, match="divide"):
+            paged_engine(
+                cfg, params,
+                dataclasses.replace(PAGED, prompt_buckets=(24,), kv_block_size=16),
+            )
+
+
+# ---------------------------------------------------------------------------
+# prefix-cache hits over the pool (block-granular reuse)
+# ---------------------------------------------------------------------------
+
+
+PC = PrefixCacheConfig(
+    enabled=True, max_prefix_tokens=48, segment_buckets=(16,),
+    suffix_buckets=(16,), hbm_budget_mb=64,
+)
+
+
+class TestPagedPrefixedAdmission:
+    @pytest.fixture(scope="class")
+    def px_setup(self):
+        cfg = LlamaConfig.tiny(vocab_size=128)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, FP32)
+        ec = EngineConfig(
+            prompt_buckets=(64,), max_batch_size=2, speculative="off",
+            max_seq_len=128, prefix_cache=PC,
+        )
+        engine = InferenceEngine(
+            cfg, params, sampling=SamplingConfig(do_sample=False, max_new_tokens=6),
+            engine_config=ec, dtypes=FP32,
+        )
+        cont = ContinuousEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=6),
+            engine_config=dataclasses.replace(ec, kv_paged=True, kv_block_size=16),
+            dtypes=FP32,
+        )
+        return cfg, engine, cont
+
+    def _drain(self, cont, rid, fin):
+        outs = {}
+        while cont.has_active():
+            for r, toks in cont.step():
+                outs[r] = toks
+        return fin if fin is not None else outs[rid]
+
+    def test_prefixed_admission_parity_and_block_sharing(self, px_setup):
+        """A cached prefix admits into pool blocks with greedy parity vs a
+        plain full-prompt admission; a SECOND admission of the same prefix
+        maps the registered full blocks copy-free (only the tail + suffix
+        blocks are freshly allocated)."""
+        cfg, engine, cont = px_setup
+        rng = np.random.default_rng(9)
+        head = [cfg.bos_token_id] + list(map(int, rng.integers(3, 120, 7)))
+        chunk = list(map(int, rng.integers(3, 120, 11)))
+        segments = [("head:p", head), ("chunk:p", chunk)]
+        suffix = list(map(int, rng.integers(3, 120, 6)))
+        cp = engine.prefix_cache.prefix_for(segments)
+        assert cp.chain_key is not None  # exact reuse → shareable identity
+
+        _, fin = cont.admit_prefixed(1, suffix, cp, max_new=6)
+        got = self._drain(cont, 1, fin)
+        full = [t for _, seg in segments for t in seg] + suffix
+        _, fin2 = cont.admit(2, full, max_new=6)
+        want = self._drain(cont, 2, fin2)
+        assert got == want
+        # the registered full prefix blocks stay pinned (cache ref)
+        registered = cont.kv_pool.blocks_in_use()
+        assert registered == cp.length // cont.block_size
+
+        allocs_before = cont.kv_pool.total_allocs
+        cp2 = engine.prefix_cache.prefix_for(segments)  # memo hit
+        _, fin3 = cont.admit_prefixed(3, suffix, cp2, max_new=6)
+        assert self._drain(cont, 3, fin3) == want
+        # hit: shared blocks were NOT reallocated — only tail + growth
+        fresh = cont.kv_pool.total_allocs - allocs_before
+        assert fresh < cont.kv_pool.blocks_for(cp.length + len(suffix))
+        assert cont.kv_pool.blocks_in_use() == registered  # rows released
+
+    def test_eos_mid_window_never_corrupts_shared_prefix_block(self, px_setup):
+        """A row hitting EOS inside a k>1 sync window keeps its table mapped
+        until the host drains the window — its junk parking-write (wi=0)
+        must land in the NULL block, not logical block 0, which here is a
+        REF-SHARED prefix block another request reads."""
+        cfg, engine, _ = px_setup
+        params = engine.params
+        samp = SamplingConfig(do_sample=False, max_new_tokens=6)
+        rng = np.random.default_rng(21)
+        head = [cfg.bos_token_id] + list(map(int, rng.integers(3, 120, 15)))
+        segments = [("head:eosw", head)]  # 16 tokens: exactly one full block
+        suffix = list(map(int, rng.integers(3, 120, 5)))
+        cp = engine.prefix_cache.prefix_for(segments)
+        assert cp.length % 16 == 0  # the whole prefix is shareable blocks
+
+        # oracle stream → pick an EOS that CANNOT fire before its index
+        # (a value repeated earlier would end the stream at token 0 and the
+        # window — and with it the hazard — would never run)
+        ref = engine.generate([head + suffix])[0]
+        idx = next(i for i in range(1, len(ref)) if ref[i] not in ref[:i])
+        eos_tok = ref[idx]
+        cfg_eos = dataclasses.replace(cfg, eos_token_ids=(eos_tok,))
+        ec = dataclasses.replace(
+            engine.engine_config, kv_paged=True, kv_block_size=16,
+            decode_sync_steps=4,
+        )
+        cont = ContinuousEngine(
+            cfg_eos, params, sampling=samp, engine_config=ec, dtypes=FP32
+        )
+        # request A registers + maps the shared block, then EOSes mid-window
+        _, finA = cont.admit_prefixed(1, suffix, cp, max_new=6)
+        outA = self._drain(cont, 1, finA)
+        assert 0 < len(outA) < 6, "EOS never fired MID-stream — vacuous fixture"
+        # request B shares the registered block: its stream must match a
+        # FRESH engine (whose shared block was never exposed to A's window)
+        cp2 = engine.prefix_cache.prefix_for(segments)
+        _, finB = cont.admit_prefixed(2, suffix, cp2, max_new=6)
+        outB = self._drain(cont, 2, finB)
+        fresh = ContinuousEngine(
+            cfg_eos, params, sampling=samp, engine_config=ec, dtypes=FP32
+        )
+        _, finF = fresh.admit_prefixed(3, suffix, cp2, max_new=6)
+        assert outB == self._drain(fresh, 3, finF)
+
+    def test_reset_drops_registrations_without_leak(self, px_setup):
+        cfg, engine, cont = px_setup
+        cont.reset()
+        assert cont.kv_pool.blocks_in_use() == 0
+
+
+# ---------------------------------------------------------------------------
+# kernel ↔ oracle parity (interpret mode; the TPU lane re-runs compiled)
+# ---------------------------------------------------------------------------
+
+
+class TestPagedKernelParity:
+    def _arena(self, rng, L=2, K=2, hd=16, bs=16, nblocks=9):
+        k = rng.standard_normal((L, nblocks, K, bs, hd)).astype(np.float32)
+        v = rng.standard_normal((L, nblocks, K, bs, hd)).astype(np.float32)
+        return jnp.asarray(k), jnp.asarray(v)
+
+    def test_paged_decode_kernel_matches_oracle(self):
+        from rag_llm_k8s_tpu.ops.attention import (
+            paged_decode_attention,
+            paged_decode_attention_xla,
+        )
+
+        rng = np.random.default_rng(0)
+        B, H, K, hd, bs, MB = 3, 4, 2, 16, 16, 4
+        ka, va = self._arena(rng, K=K, hd=hd, bs=bs, nblocks=1 + B * MB)
+        tables = np.zeros((B, MB), np.int32)
+        kv_len = np.array([5, 33, 64], np.int32)
+        phys = 1
+        for b in range(B):
+            for j in range(-(-int(kv_len[b]) // bs)):
+                tables[b, j] = phys
+                phys += 1
+        q = jnp.asarray(rng.standard_normal((B, 1, H, hd)).astype(np.float32))
+        for lay in range(2):
+            want = paged_decode_attention_xla(
+                q, ka, va, jnp.asarray(tables), jnp.asarray(kv_len),
+                jnp.int32(lay),
+            )
+            got = paged_decode_attention(
+                q, ka, va, jnp.asarray(tables), jnp.asarray(kv_len),
+                jnp.int32(lay), interpret=True,
+            )
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-5
+            )
+
+    def test_paged_chunk_kernel_matches_oracle(self):
+        from rag_llm_k8s_tpu.ops.attention import (
+            paged_chunk_attention,
+            paged_chunk_attention_xla,
+        )
+
+        rng = np.random.default_rng(1)
+        B, S, H, K, hd, bs, MB = 2, 8, 4, 2, 16, 16, 4
+        ka, va = self._arena(rng, K=K, hd=hd, bs=bs, nblocks=1 + B * MB)
+        tables = np.zeros((B, MB), np.int32)
+        kv_len = np.array([20, 41], np.int32)
+        wi = kv_len - S
+        phys = 1
+        for b in range(B):
+            for j in range(-(-int(kv_len[b]) // bs)):
+                tables[b, j] = phys
+                phys += 1
+        q = jnp.asarray(rng.standard_normal((B, S, H, hd)).astype(np.float32))
+        want = paged_chunk_attention_xla(
+            q, ka, va, jnp.asarray(tables), jnp.asarray(kv_len),
+            jnp.int32(1), jnp.asarray(wi),
+        )
+        got = paged_chunk_attention(
+            q, ka, va, jnp.asarray(tables), jnp.asarray(kv_len),
+            jnp.int32(1), jnp.asarray(wi), bq=4, interpret=True,
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    def test_paged_q8_decode_kernel_matches_oracle(self):
+        from rag_llm_k8s_tpu.ops.attention import (
+            paged_decode_attention_q8,
+            paged_decode_attention_xla_q8,
+        )
+
+        rng = np.random.default_rng(2)
+        B, H, K, hd, bs, MB = 2, 4, 2, 16, 32, 2
+        N = 1 + B * MB
+        ka = rng.integers(-127, 128, (2, N, K, bs, hd)).astype(np.int8)
+        va = rng.integers(-127, 128, (2, N, K, bs, hd)).astype(np.int8)
+        ks = rng.uniform(0.001, 0.02, (2, N, K, bs)).astype(np.float32)
+        vs = rng.uniform(0.001, 0.02, (2, N, K, bs)).astype(np.float32)
+        tables = np.zeros((B, MB), np.int32)
+        kv_len = np.array([10, 50], np.int32)
+        phys = 1
+        for b in range(B):
+            for j in range(-(-int(kv_len[b]) // bs)):
+                tables[b, j] = phys
+                phys += 1
+        q = jnp.asarray(rng.standard_normal((B, 1, H, hd)).astype(np.float32))
+        args = (
+            q, jnp.asarray(ka), jnp.asarray(va), jnp.asarray(ks),
+            jnp.asarray(vs), jnp.asarray(tables), jnp.asarray(kv_len),
+            jnp.int32(0),
+        )
+        want = paged_decode_attention_xla_q8(*args)
+        got = paged_decode_attention_q8(*args, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)

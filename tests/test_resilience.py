@@ -345,6 +345,35 @@ class TestResetRecovery:
         finally:
             sched.shutdown()
 
+    def test_paged_reset_returns_all_blocks_to_the_pool(self, tiny):
+        """ISSUE 5 chaos contract: an injected EngineStateLost on the PAGED
+        engine recovers via resubmit (greedy stream intact) and hands every
+        pool block back — a leak here compounds a reset at a time into
+        permanent pool backpressure while /healthz stays green."""
+        import dataclasses
+
+        cfg, params, oracle = tiny
+        want = oracle.generate([[3, 17, 42, 7, 99]])[0]
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=dataclasses.replace(
+                ENG_CFG, kv_paged=True, kv_block_size=16
+            ),
+            dtypes=FP32,
+        )
+        sched = ContinuousScheduler(eng, retry_backoff_s=0.0)
+        try:
+            for site in ("insert", "decode_step"):
+                faults.arm(site, times=1)
+                out = sched.submit([3, 17, 42, 7, 99], timeout=120)
+                assert out == want, site
+                assert faults.armed() == {}, f"{site} fault never fired"
+                assert eng.kv_pool.blocks_in_use() == 0, (
+                    site, eng.kv_pool.stats(),
+                )
+        finally:
+            sched.shutdown()
+
     def test_second_fault_gives_up_with_the_error(self, tiny):
         """retries=1 means exactly one recovery: a device that faults on
         the retry too fails the request (no infinite resubmit loop)."""
