@@ -44,6 +44,14 @@ bench:
 chaos:
 	env TPU_RAG_FAULTS=1 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q -p no:cacheprovider
 
+# Tensor-parallel paged smoke (ISSUE 6): the head-sharded arena + the
+# shard_map'd paged kernels on the fake 2-device CPU mesh (conftest forces
+# 8 virtual host devices) — byte-identical greedy streams vs dense tp=2 and
+# paged tp=1, interpret-mode kernel↔oracle parity under the serving
+# partition specs, and zero leaked blocks at tp=2.
+tp2-smoke:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_kv_pool_tp.py -q -p no:cacheprovider
+
 # Perf regression gate (scripts/bench_gate.py): compare a fresh bench JSON
 # against a committed baseline with per-metric tolerance bands, direction
 # aware (latency up = bad, tok/s down = bad). Defaults to comparing the
@@ -94,7 +102,7 @@ check: test tpu-test bench
 # (validates the baseline + gate plumbing without running the bench — the
 # TPU-judged comparison is `make bench` followed by
 # `make bench-gate BENCH_CURRENT=...`).
-ci: tier1 chaos lint
+ci: tier1 chaos tp2-smoke lint
 	python scripts/bench_gate.py --baseline $(BENCH_BASELINE) --dry-run
 
-.PHONY: test tier1 tpu-test bench bench-gate chaos ci lint check validate-8b validate-70b
+.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke ci lint check validate-8b validate-70b

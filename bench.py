@@ -1693,6 +1693,43 @@ def measure_continuous() -> dict:
     return out
 
 
+def _paged_chained_rate(
+    eng, sync: int, n_calls: int, rtt_ms: float, horizon: int
+) -> float:
+    """Chained-window PAGED device step rate (shared by ``measure_paged``
+    and ``measure_paged_tp`` — the timing discipline must not fork): pre-map
+    every block the run will write up to ``horizon`` (the raw device loop
+    bypasses ``step()``'s per-window ``_ensure_decode_blocks``), thread the
+    donated state executable-to-executable, one settling fetch per pass,
+    best of 3 passes with the tunnel RTT subtracted."""
+    import numpy as np
+
+    for slot in eng.slots:
+        if slot.active:
+            slot.kv_ub = horizon
+    eng._ensure_decode_blocks()
+    fn = eng._get("step_paged", sync)
+    tables = eng._device_tables()
+    state = (eng._cache, eng._kv_len, eng._last_tok, eng._active)
+    rng = eng._rng_keys
+
+    def run_n(n, cache, kv_len, last_tok, active):
+        for _ in range(n):
+            cache, kv_len, last_tok, toks, _, active = fn(
+                eng.params, cache, tables, kv_len, last_tok, active, rng
+            )
+        np.asarray(toks[0, 0])  # settle
+        return cache, kv_len, last_tok, active
+
+    state = run_n(1, *state)
+    best = 1e9
+    for _ in range(3):
+        t0 = time.monotonic()
+        state = run_n(n_calls, *state)
+        best = min(best, (time.monotonic() - t0) - rtt_ms / 1e3)
+    return n_calls * sync / best
+
+
 def measure_paged() -> dict:
     """Paged (block-pool) vs dense slot-cache DEVICE decode step rate
     (ISSUE 5 acceptance leg). Same discipline as
@@ -1780,33 +1817,9 @@ def measure_paged() -> dict:
             [(i, [config.bos_token_id] * PLEN, NEW_TOKENS, None)
              for i in range(batch)]
         )
-        # pre-map every block the chained run will write: the device loop
-        # below bypasses step()'s per-window _ensure_decode_blocks
-        for slot in eng.slots:
-            if slot.active:
-                slot.kv_ub = horizon
-        eng._ensure_decode_blocks()
-        fn = eng._get("step_paged", SYNC)
-        tables = eng._device_tables()
-        state = (eng._cache, eng._kv_len, eng._last_tok, eng._active)
-        rng = eng._rng_keys
-
-        def run_n(n, cache, kv_len, last_tok, active):
-            for _ in range(n):
-                cache, kv_len, last_tok, toks, _, active = fn(
-                    eng.params, cache, tables, kv_len, last_tok, active, rng
-                )
-            np.asarray(toks[0, 0])  # settle
-            return cache, kv_len, last_tok, active
-
-        state = run_n(1, *state)
-        best = 1e9
-        for _ in range(3):
-            t0 = time.monotonic()
-            state = run_n(n_calls, *state)
-            best = min(best, (time.monotonic() - t0) - rtt_ms / 1e3)
+        rate = _paged_chained_rate(eng, SYNC, n_calls, rtt_ms, horizon)
         del eng
-        return n_calls * SYNC / best
+        return rate
 
     out = {
         "paged_decode_steps_per_s": {
@@ -1841,6 +1854,82 @@ def measure_paged() -> dict:
     }
     out["paged_admittable_gain"] = round(paged_slots / 8.0, 2)
     return out
+
+
+def measure_paged_tp() -> dict:
+    """Tensor-parallel PAGED decode (ISSUE 6 acceptance leg): the 1B model
+    over a dp=1,sp=1,tp=N mesh serving from the HEAD-SHARDED block-pool
+    arena — each device holds K/tp kv heads of every physical block, block
+    tables stay replicated host-side, and the paged step executable lowers
+    with the shard_map'd kernels (ops.attention.paged_partition_specs).
+    Reports the chained-window device step rate at B=8 (same discipline as
+    ``measure_paged``) plus PER-DEVICE arena residency read from the placed
+    planes' addressable shards — exact, and the ~1/tp split IS the layout's
+    HBM-per-device claim. On a single-chip platform tp degrades to 1 and
+    the leg still emits (the split is trivially whole)."""
+    import jax
+    import jax.numpy as jnp
+
+    from rag_llm_k8s_tpu.core.config import (
+        DTypePolicy,
+        EngineConfig,
+        LlamaConfig,
+        MeshConfig,
+        SamplingConfig,
+    )
+    from rag_llm_k8s_tpu.core.mesh import make_mesh
+    from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine
+    from rag_llm_k8s_tpu.models.llama import init_llama_params
+    from rag_llm_k8s_tpu.parallel.sharding import shard_llama_params
+
+    config = LlamaConfig.llama_3_2_1b()
+    dtypes = DTypePolicy()
+    tp = 1
+    while tp * 2 <= min(len(jax.devices()), config.num_kv_heads):
+        tp *= 2
+    ctx = make_mesh(MeshConfig(dp=1, sp=1, tp=tp), devices=jax.devices()[:tp])
+    shapes = jax.eval_shape(
+        lambda: init_llama_params(jax.random.PRNGKey(0), config, dtypes)
+    )
+    params = shard_llama_params(
+        jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes), ctx
+    )
+    PLEN, BUCKET, WINDOW, BS, SYNC = 300, 512, 2048, 16, 16
+    BATCH_TP = 8
+    rtt_ms = measure_tunnel_fetch_ms()
+    n_calls = max(1, (NEW_TOKENS - SYNC) // SYNC)
+    horizon = PLEN + (1 + 3 * n_calls) * SYNC + SYNC
+    blocks_per_row = -(-horizon // BS) + 1
+    eng = ContinuousEngine(
+        config, params,
+        sampling=SamplingConfig(do_sample=False, max_new_tokens=NEW_TOKENS),
+        engine_config=EngineConfig(
+            prompt_buckets=(BUCKET,), max_batch_size=BATCH_TP,
+            max_seq_len=WINDOW, decode_sync_steps=SYNC,
+            kv_paged=True, kv_block_size=BS,
+            kv_pool_blocks=max(BATCH_TP * blocks_per_row, WINDOW // BS),
+        ),
+        dtypes=dtypes, mesh=ctx,
+    )
+    eng.warmup(batch_sizes=(BATCH_TP,))
+    eng.admit_many(
+        [(i, [config.bos_token_id] * PLEN, NEW_TOKENS, None)
+         for i in range(BATCH_TP)]
+    )
+    rate = _paged_chained_rate(eng, SYNC, n_calls, rtt_ms, horizon)
+    per_device = {k: int(v) for k, v in sorted(eng._arena_device_bytes.items())}
+    total = sum(per_device.values()) or 1
+    return {
+        "paged_tp": {
+            "tp": tp,
+            "b8_steps_per_s": round(rate, 1),
+            # the head-sharded layout's HBM claim, measured not asserted:
+            # every device's share ≈ arena_global / tp
+            "arena_device_bytes": per_device,
+            "arena_bytes_total": total,
+            "arena_max_device_frac": round(max(per_device.values()) / total, 3),
+        }
+    }
 
 
 def measure_cpu_baseline() -> float:
@@ -2025,6 +2114,7 @@ def bench_legs(line: dict):
         ("speculative", lambda: line.update(measure_speculative())),
         ("continuous", lambda: line.update(measure_continuous())),
         ("paged_kv", lambda: line.update(measure_paged())),
+        ("paged_tp", lambda: line.update(measure_paged_tp())),
         ("query_e2e", lambda: line.update(measure_query_e2e())),
         ("ingest_scale", lambda: line.update(measure_ingest_scale())),
     ]

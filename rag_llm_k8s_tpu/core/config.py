@@ -452,10 +452,33 @@ class EngineConfig:
     # block_size) — same worst-case HBM as the dense cache, but shared, so
     # real mixed-length traffic fits far more rows. Size it DOWN to trade
     # worst-case capacity for HBM (admission backpressures instead of
-    # crashing when it runs out). Env: TPU_RAG_KV_POOL_BLOCKS.
+    # crashing when it runs out). NO tp rounding/padding applies to this
+    # count: on a tp>1 mesh the arena shards its KV-HEAD axis (each device
+    # holds num_kv_heads/tp heads of EVERY block — docs/KV_POOL.md
+    # "tensor-parallel layout"), so the block count is tp-invariant and
+    # per-device arena HBM is total/tp exactly; the divisibility that IS
+    # required (num_kv_heads % tp == 0) is checked by validate_tp_layout
+    # at engine construction. Env: TPU_RAG_KV_POOL_BLOCKS.
     kv_pool_blocks: int = 0
     # cross-request KV prefix cache (see PrefixCacheConfig)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
+
+    def validate_tp_layout(self, tp: int, num_kv_heads: int) -> None:
+        """Paged KV on a ``tp > 1`` mesh serves from a HEAD-sharded arena:
+        each device holds ``num_kv_heads / tp`` heads of every physical
+        block, so the kv-head count must tile the axis (the pool's BLOCK
+        count needs no such rounding — see ``kv_pool_blocks`` above).
+        Engines call this at construction so a bad pairing fails with the
+        fix spelled out, not per-request."""
+        if not self.kv_paged or tp <= 1:
+            return
+        if num_kv_heads % tp:
+            raise ValueError(
+                f"kv_paged on a tp={tp} mesh shards the arena's kv-head "
+                f"axis: num_kv_heads={num_kv_heads} must be divisible by "
+                f"tp — choose a tp that divides the head count, or serve "
+                "this model dense on the mesh"
+            )
 
 
 @dataclass(frozen=True)

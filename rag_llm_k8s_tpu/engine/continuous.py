@@ -140,12 +140,12 @@ class ContinuousEngine:
         self.paged = bool(getattr(engine_config, "kv_paged", False))
         self.kv_pool: Optional[KVBlockPool] = None
         if self.paged:
-            if mesh is not None and mesh.tp > 1:
-                raise ValueError(
-                    "kv_paged does not support tp>1 meshes yet — the arena "
-                    "has no shard_map'd paged kernels; run paged on tp=1 or "
-                    "keep the dense slot cache on multi-chip"
-                )
+            # tp>1 is served by the HEAD-SHARDED arena (each device holds
+            # K/tp heads of every block; ops.attention.paged_partition_specs)
+            # — the only constraint is that the kv-head count tiles the axis
+            engine_config.validate_tp_layout(
+                mesh.tp if mesh is not None else 1, config.num_kv_heads
+            )
             bs = int(engine_config.kv_block_size)
             min_tile = 32 if self.kv_quant == "int8" else 16
             if bs < 1 or bs % min_tile:
@@ -205,6 +205,20 @@ class ContinuousEngine:
         # (k, v) bf16, or (k, v, k_scale, v_scale) with kv_quant="int8" —
         # the int8 payloads and fp32 scale planes donate/rebuild together
         self._cache = self._fresh_cache()
+        # per-device arena residency, captured ONCE from the freshly built
+        # planes (sharding is static: reset() rebuilds identical shapes, so
+        # this never goes stale). The scrape-thread gauge reads this dict —
+        # touching the LIVE planes there would race a step's donation and
+        # crash /metrics with "Array has been deleted"
+        self._arena_device_bytes: Dict[str, float] = {}
+        if self.paged:
+            for plane in self._cache:
+                for sh in plane.addressable_shards:
+                    did = str(getattr(sh.device, "id", 0))
+                    self._arena_device_bytes[did] = (
+                        self._arena_device_bytes.get(did, 0.0)
+                        + float(sh.data.nbytes)
+                    )
         self._kv_start = self._put(jnp.zeros((self.B,), jnp.int32))
         self._kv_len = self._put(jnp.zeros((self.B,), jnp.int32))
         self._last_tok = self._put(jnp.zeros((self.B,), jnp.int32))
@@ -291,6 +305,20 @@ class ContinuousEngine:
             "rows preempted mid-decode by pool exhaustion (resubmitted by "
             "the scheduler; callers see latency, not errors)",
         )
+        # per-device arena residency (tp triage: head-sharded arenas show
+        # ~total/tp per chip — a device whose share diverges is holding
+        # something else). Values come from the construction-time static
+        # dict, never the live planes (see __init__)
+        dev_fam = registry.labeled_gauge(
+            "rag_kv_pool_device_bytes",
+            "paged KV arena bytes resident per device (head-sharded over "
+            "tp: ~arena_total/tp per chip; 0 under the dense cache)",
+        )
+        for did in sorted(self._arena_device_bytes) or ["0"]:
+            dev_fam.labels_callback(
+                lambda did=did: self._arena_device_bytes.get(did, 0.0),
+                device=did,
+            )
 
     def warmup(self, batch_sizes=None, buckets=None):
         """AOT-compile every executable serving will hit (readiness gating).
@@ -415,10 +443,15 @@ class ContinuousEngine:
         """(cache_payload, cache_scale, replicated) NamedShardings — or all
         None off-mesh. The cache shards its kv-head axis over tp (matching
         the attention kernels' shard_map specs) when head counts divide;
-        everything host-fed is replicated. Executables are lowered with and
-        return EXACTLY these, so state tuples round-trip between prefill →
-        insert → step without 'sharding does not match' rejections (an
-        unsharded lowering bricks every request on a tp>1 mesh)."""
+        everything host-fed is replicated. The SAME specs serve both
+        layouts: the dense ``[L, B, K, T, hd]`` cache and the paged
+        ``[L, N, K, bs, hd]`` arena put kv heads at dim 2 (and the scale
+        planes drop the trailing hd either way), so the head-sharded arena
+        is spec-identical to the dense tp cache. Executables are lowered
+        with and return EXACTLY these, so state tuples round-trip between
+        prefill → insert → step without 'sharding does not match'
+        rejections (an unsharded lowering bricks every request on a tp>1
+        mesh)."""
         if self.mesh is None:
             return None, None, None
         rep = self.mesh.replicated
@@ -437,6 +470,12 @@ class ContinuousEngine:
         if self.kv_quant == "int8":
             return (pay, pay, sc, sc)
         return (pay, pay)
+
+    def _arena_shardings(self):
+        """Per-plane shardings for the PAGED arena tuple — identical to the
+        dense cache's (``_shardings``: kv heads at dim 2 in both layouts),
+        aliased for call-site clarity."""
+        return self._cache_shardings()
 
     def _cache_avals(self, batch: int, length: int):
         """ShapeDtypeStructs (with shardings, on-mesh) for the cache tuple."""
@@ -895,14 +934,16 @@ class ContinuousEngine:
     # paged executables (EngineConfig.kv_paged)
     # ------------------------------------------------------------------
     def _arena_avals(self):
-        """ShapeDtypeStructs for the arena plane tuple."""
+        """ShapeDtypeStructs for the arena plane tuple (head-sharded over
+        tp on a mesh — the same ``_shardings`` specs as the dense cache,
+        since kv heads sit at dim 2 in both layouts)."""
         L, K, hd = self.config.num_layers, self.config.num_kv_heads, self.config.head_dim
         N, bs = self.kv_pool.num_blocks, self.block_size
         cdt = jnp.int8 if self.kv_quant == "int8" else self.dtypes.compute_dtype
-        rep = self.mesh.replicated if self.mesh is not None else None
-        payload = jax.ShapeDtypeStruct((L, N, K, bs, hd), cdt, sharding=rep)
+        pay_sh, sc_sh, _ = self._shardings()
+        payload = jax.ShapeDtypeStruct((L, N, K, bs, hd), cdt, sharding=pay_sh)
         if self.kv_quant == "int8":
-            scale = jax.ShapeDtypeStruct((L, N, K, bs), jnp.float32, sharding=rep)
+            scale = jax.ShapeDtypeStruct((L, N, K, bs), jnp.float32, sharding=sc_sh)
             return (payload, payload, scale, scale)
         return (payload, payload)
 
@@ -935,7 +976,13 @@ class ContinuousEngine:
             return rows, tok0
 
         rep = self.mesh.replicated if self.mesh is not None else None
-        return jax.jit(prefill).lower(
+        # pin output shardings so the row block arrives EXACTLY as
+        # insert_paged's lowered avals expect it (same contract as the
+        # dense prefill → insert pair)
+        out_shardings = (
+            (self._cache_shardings(), rep) if self.mesh is not None else None
+        )
+        return jax.jit(prefill, out_shardings=out_shardings).lower(
             param_avals(self.params),
             jax.ShapeDtypeStruct((n, S), jnp.int32, sharding=rep),
             jax.ShapeDtypeStruct((n,), jnp.int32, sharding=rep),
@@ -987,7 +1034,13 @@ class ContinuousEngine:
         i32 = jnp.int32
         rep = self.mesh.replicated if self.mesh is not None else None
         row_avals = self._cache_avals(n, S)
-        return jax.jit(insert, donate_argnums=(0, 2, 3, 5)).lower(
+        out_shardings = (
+            (self._arena_shardings(), rep, rep, rep, rep)
+            if self.mesh is not None else None
+        )
+        return jax.jit(
+            insert, donate_argnums=(0, 2, 3, 5), out_shardings=out_shardings
+        ).lower(
             self._arena_avals(),
             row_avals,
             jax.ShapeDtypeStruct((self.B,), i32, sharding=rep),
@@ -1062,7 +1115,13 @@ class ContinuousEngine:
 
         i32 = jnp.int32
         rep = self.mesh.replicated if self.mesh is not None else None
-        return jax.jit(step, donate_argnums=(1, 3, 4, 5)).lower(
+        out_shardings = (
+            (self._arena_shardings(), rep, rep, rep, rep, rep)
+            if self.mesh is not None else None
+        )
+        return jax.jit(
+            step, donate_argnums=(1, 3, 4, 5), out_shardings=out_shardings
+        ).lower(
             param_avals(self.params),
             self._arena_avals(),
             jax.ShapeDtypeStruct((B, self.MB), i32, sharding=rep),
@@ -1107,7 +1166,12 @@ class ContinuousEngine:
             jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
             for shape, dtype in self._prefix_plane_shapes(P)
         )
-        return jax.jit(scatter, donate_argnums=(0,)).lower(
+        out_shardings = (
+            self._arena_shardings() if self.mesh is not None else None
+        )
+        return jax.jit(
+            scatter, donate_argnums=(0,), out_shardings=out_shardings
+        ).lower(
             self._arena_avals(),
             plane_avals,
             jax.ShapeDtypeStruct((nbp,), i32, sharding=rep),
@@ -1144,7 +1208,12 @@ class ContinuousEngine:
             return out, tok0
 
         rep = self.mesh.replicated if self.mesh is not None else None
-        return jax.jit(px, donate_argnums=(1,)).lower(
+        out_shardings = (
+            (self._arena_shardings(), rep) if self.mesh is not None else None
+        )
+        return jax.jit(
+            px, donate_argnums=(1,), out_shardings=out_shardings
+        ).lower(
             param_avals(self.params),
             self._arena_avals(),
             jax.ShapeDtypeStruct((1, self.MB), i32, sharding=rep),

@@ -312,8 +312,10 @@ class Attention(nn.Module):
     # the table, attention streams only LIVE blocks (ops.attention paged
     # kernels). Valid for decode (row_frontier) and chunked prefill — fresh
     # whole-row prefill stays dense and is scattered in by the engine's
-    # insert executable. tp>1 routes to the sharding-transparent XLA paged
-    # path (no shard_map'd paged kernel yet).
+    # insert executable. tp>1 with head counts dividing the axis runs the
+    # kernels shard-aware (shard_map over the head-sharded arena,
+    # ops.attention.paged_partition_specs); otherwise the
+    # sharding-transparent XLA paged path serves.
     paged: bool = False
 
     def _resolved_impl(self) -> str:
@@ -331,10 +333,22 @@ class Attention(nn.Module):
         write_index=None, scales=None,
     ) -> jax.Array:
         """Paged-arena dispatch: ``k``/``v`` are the [L, N, K, bs, hd]
-        arenas, the row's blocks resolve through ``block_tables``. tp>1
-        (or ``attn_impl="xla"``) takes the gather-based XLA path; the q8
-        CHUNK case always does (see paged_chunk_attention_xla_q8 — chunk
-        prefill is per-admission, the steady-state decode stays fused)."""
+        arenas, the row's blocks resolve through ``block_tables``.
+
+        tp>1 with head counts dividing the axis runs the paged kernels
+        SHARD-AWARE: ``shard_map`` over the tp mesh axis with the
+        head-sharded arena rules (``ops.attention.paged_partition_specs``)
+        — each device streams its local K/tp head slice of the row's live
+        blocks through the same SMEM-prefetched table indirection, so
+        per-device decode bandwidth scales as live_tokens × K/tp; the
+        cross-shard reduce is the wo psum XLA already inserts, exactly as
+        on the dense tp path. ``attn_impl="xla"`` (and head counts that
+        don't tile tp) takes the sharding-transparent gather-based oracle;
+        the q8 CHUNK case always does (paged_chunk_attention_xla_q8 —
+        chunk prefill is per-admission, the steady-state decode stays
+        fused)."""
+        from rag_llm_k8s_tpu.ops.attention import paged_partition_specs
+
         impl = self._resolved_impl()
         mesh = self.mesh
         tp = (
@@ -342,9 +356,31 @@ class Attention(nn.Module):
             if mesh is not None and "tp" in mesh.axis_names
             else 1
         )
-        use_xla = impl == "xla" or tp > 1
+        # q heads at dim 2; arena kv heads at dim 2 ([L, N, K, bs, hd]).
+        # K % tp == 0 implies H % tp == 0 (H = K * group), but check both —
+        # the degradation must mirror the dense path's exactly
+        H, K = q.shape[2], k.shape[2]
+        heads_shardable = tp > 1 and H % tp == 0 and K % tp == 0
+        if impl != "xla" and tp > 1 and not heads_shardable:
+            # head counts don't tile the tp axis: an unsharded Pallas call
+            # inside the mesh program would force a full-arena gather — the
+            # sharding-transparent XLA path is strictly better
+            impl = "xla"
+        use_xla = impl == "xla"
         interpret = impl == "pallas_interpret"
         lay1 = jnp.asarray(layer, jnp.int32).reshape(1)
+
+        def shard(kernel, specs_mode, q8):
+            if not heads_shardable:
+                return kernel
+            from jax.experimental.shard_map import shard_map
+
+            in_specs, out_spec = paged_partition_specs(specs_mode, q8=q8)
+            return shard_map(
+                kernel, mesh=mesh, in_specs=in_specs, out_specs=out_spec,
+                check_rep=False,
+            )
+
         if mode == "decode":
             if use_xla:
                 if scales is not None:
@@ -355,13 +391,25 @@ class Attention(nn.Module):
                     q, k, v, block_tables, kv_len, lay1
                 )
             if scales is not None:
-                return paged_decode_attention_q8(
-                    q, k, v, scales[0], scales[1], block_tables, kv_len, lay1,
-                    interpret=interpret,
+                kernel = shard(
+                    lambda q_, k_, v_, ks_, vs_, t_, l_, lay_: (
+                        paged_decode_attention_q8(
+                            q_, k_, v_, ks_, vs_, t_, l_, lay_,
+                            interpret=interpret,
+                        )
+                    ),
+                    "decode", True,
                 )
-            return paged_decode_attention(
-                q, k, v, block_tables, kv_len, lay1, interpret=interpret
+                return kernel(
+                    q, k, v, scales[0], scales[1], block_tables, kv_len, lay1
+                )
+            kernel = shard(
+                lambda q_, k_, v_, t_, l_, lay_: paged_decode_attention(
+                    q_, k_, v_, t_, l_, lay_, interpret=interpret
+                ),
+                "decode", False,
             )
+            return kernel(q, k, v, block_tables, kv_len, lay1)
         assert mode == "chunk", f"paged attention has no {mode!r} mode"
         B = q.shape[0]
         wi = jnp.broadcast_to(jnp.asarray(write_index, jnp.int32), (B,))
@@ -371,9 +419,13 @@ class Attention(nn.Module):
             )
         if use_xla:
             return paged_chunk_attention_xla(q, k, v, block_tables, kv_len, lay1, wi)
-        return paged_chunk_attention(
-            q, k, v, block_tables, kv_len, lay1, wi, interpret=interpret
+        kernel = shard(
+            lambda q_, k_, v_, t_, l_, lay_, wi_: paged_chunk_attention(
+                q_, k_, v_, t_, l_, lay_, wi_, interpret=interpret
+            ),
+            "chunk", False,
         )
+        return kernel(q, k, v, block_tables, kv_len, lay1, wi)
 
     def _attend(
         self, q, k, v, kv_start, kv_len, layer, *, mode: str, write_index=None,

@@ -991,6 +991,18 @@ def chunk_prefill_attention_q8(
 # blocks a row has not reached point at the reserved null block 0
 # (engine/kv_pool.py): the index map may prefetch it, but the block-skip
 # predicate (kj * bs >= kv_len) guarantees it is never computed on.
+#
+# Tensor parallelism: on a tp>1 mesh the arena is HEAD-SHARDED — each device
+# holds [L, N, K/tp, bs, hd], i.e. its K/tp kv heads of EVERY physical block
+# (paged_partition_specs below; models/llama.py wraps these kernels in
+# shard_map with exactly those rules). Block tables, kv_len, and the layer
+# scalar stay replicated: allocation is per-ROW, never per-head, so one
+# host-side table drives all shards and the free-list/ref-count allocator
+# needs no tp awareness at all. Inside the shard each kernel is UNCHANGED —
+# K in the shapes above is simply the local head count — and per-device
+# decode bandwidth scales as live_tokens × K/tp; the cross-device reduce is
+# the wo projection's row-parallel psum that XLA already inserts, identical
+# to the dense tp path.
 
 
 def _paged_decode_kernel(
@@ -1409,6 +1421,43 @@ def paged_chunk_attention(
     )
 
     return out.reshape(B, H, S, hd).transpose(0, 2, 1, 3)
+
+
+def paged_partition_specs(mode: str, q8: bool = False):
+    """``(in_specs, out_spec)`` for ``shard_map``-ing the paged kernels over
+    the ``tp`` mesh axis — THE partition rules of the head-sharded arena
+    layout (kept here, next to the kernels they describe, so the model and
+    the parity tests lower the exact same specs):
+
+    - q / output ``[B, S, H, hd]`` → heads over ``tp``;
+    - arena planes ``[L, N, K, bs, hd]`` (and ``[L, N, K, bs]`` scales) →
+      kv heads over ``tp``: every device holds K/tp heads of EVERY block;
+    - block tables ``[B, MB]``, ``kv_len [B]``, ``layer [1]``, and the
+      chunk path's per-row ``write_index [B]`` → replicated (allocation is
+      per-row, so one host table serves all shards).
+
+    ``mode``: ``"decode"`` (args ``q, k, v[, ks, vs], tables, kv_len,
+    layer``) or ``"chunk"`` (args ``q, k, v, tables, kv_len, layer, wi``;
+    the q8 chunk path serves from its XLA oracle, so no q8 spec exists)."""
+    from jax.sharding import PartitionSpec as P
+
+    hspec = P(None, None, "tp", None)  # q / o: [B, S, H, hd]
+    aspec = P(None, None, "tp", None, None)  # arena: [L, N, K, bs, hd]
+    sspec = P(None, None, "tp", None)  # scales: [L, N, K, bs]
+    tspec = P(None, None)  # tables: [B, MB]
+    vspec = P(None)  # kv_len / layer / write_index
+    if mode == "decode":
+        if q8:
+            return (hspec, aspec, aspec, sspec, sspec, tspec, vspec, vspec), hspec
+        return (hspec, aspec, aspec, tspec, vspec, vspec), hspec
+    if mode == "chunk":
+        if q8:
+            raise ValueError(
+                "the paged q8 chunk path serves from its XLA oracle "
+                "(paged_chunk_attention_xla_q8) — no shard_map spec exists"
+            )
+        return (hspec, aspec, aspec, tspec, vspec, vspec, vspec), hspec
+    raise ValueError(f"paged_partition_specs: unknown mode {mode!r}")
 
 
 def _gather_paged_layer(

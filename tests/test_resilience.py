@@ -374,6 +374,41 @@ class TestResetRecovery:
         finally:
             sched.shutdown()
 
+    def test_paged_tp2_reset_returns_all_blocks_to_the_pool(self, tiny):
+        """ISSUE 6 chaos contract: the same zero-leak guarantee on the
+        HEAD-SHARDED arena — an injected EngineStateLost at tp=2 recovers
+        via resubmit with the greedy stream intact, and the (replicated,
+        host-side) allocator hands every block back. The tp split must not
+        open a leak path reset recovery misses."""
+        import dataclasses
+
+        from rag_llm_k8s_tpu.core.config import MeshConfig
+        from rag_llm_k8s_tpu.core.mesh import make_mesh
+        from rag_llm_k8s_tpu.parallel.sharding import shard_llama_params
+
+        cfg, params, oracle = tiny
+        want = oracle.generate([[3, 17, 42, 7, 99]])[0]
+        ctx = make_mesh(MeshConfig(dp=4, sp=1, tp=2))
+        eng = ContinuousEngine(
+            cfg, shard_llama_params(params, ctx), sampling=GREEDY,
+            engine_config=dataclasses.replace(
+                ENG_CFG, kv_paged=True, kv_block_size=16
+            ),
+            dtypes=FP32, mesh=ctx,
+        )
+        sched = ContinuousScheduler(eng, retry_backoff_s=0.0)
+        try:
+            for site in ("insert", "decode_step"):
+                faults.arm(site, times=1)
+                out = sched.submit([3, 17, 42, 7, 99], timeout=120)
+                assert out == want, site
+                assert faults.armed() == {}, f"{site} fault never fired"
+                assert eng.kv_pool.blocks_in_use() == 0, (
+                    site, eng.kv_pool.stats(),
+                )
+        finally:
+            sched.shutdown()
+
     def test_second_fault_gives_up_with_the_error(self, tiny):
         """retries=1 means exactly one recovery: a device that faults on
         the retry too fails the request (no infinite resubmit loop)."""
