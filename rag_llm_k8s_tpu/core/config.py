@@ -482,6 +482,57 @@ class EngineConfig:
 
 
 @dataclass(frozen=True)
+class LookaheadConfig:
+    """Retrieval lookahead pipeline (rag/lookahead.py — TeleRAG-style).
+
+    Takes embed+KNN off the request critical path: retrieval for a request
+    launches the moment its body is parsed (before the admission gate can
+    queue it), runs on a bounded executor concurrently with in-flight
+    decode, and the serving tail *joins* the already-launched future. When
+    a retrieval resolves and the KV prefix cache is enabled, the resolved
+    chunks' segment KV is pre-staged into prefix-cache entries (and, on a
+    paged continuous engine, registered pool blocks) so admission splices
+    instead of prefilling. Sessions (requests carrying ``session_id``)
+    additionally speculate turn N+1's retrieval from the accumulating
+    conversation state while turn N decodes. Results are always served
+    from the SAME retrieval entry points the sequential path uses — greedy
+    output streams are byte-identical with lookahead on or off
+    (tests/test_lookahead.py / ``make lookahead-smoke``).
+    """
+
+    # master switch (env TPU_RAG_LOOKAHEAD). Off by default: lookahead
+    # spends device time on speculation — deployments opt in.
+    enabled: bool = False
+    # executor worker threads running tokenize/embed+KNN joins (each worker
+    # blocks in the retrieve coalescer, so embeds still batch with live
+    # traffic's; env TPU_RAG_LOOKAHEAD_WORKERS)
+    max_workers: int = 2
+    # bound on launched-but-UNRESOLVED retrievals: launches beyond it are
+    # SKIPPED, never queued — speculation must not pile up behind a slow
+    # device. Resolved-but-unconsumed futures are bounded by ttl_s (the
+    # sweeper), not by this knob. (env TPU_RAG_LOOKAHEAD_INFLIGHT)
+    max_inflight: int = 8
+    # unconsumed futures (and their pre-staged KV) expire after this long;
+    # expiry is counted as waste (env TPU_RAG_LOOKAHEAD_TTL_S)
+    ttl_s: float = 30.0
+    # build/refresh the resolved chunks' prefix-cache KV the moment a
+    # retrieval resolves, gated on pool/HBM headroom
+    # (env TPU_RAG_LOOKAHEAD_PRESTAGE)
+    prestage_kv: bool = True
+    # speculate turn N+1's retrieval for sessions while turn N decodes
+    # (env TPU_RAG_LOOKAHEAD_SESSIONS)
+    session_pipelining: bool = True
+    # how many trailing user turns feed the speculative next-turn query
+    # (env TPU_RAG_LOOKAHEAD_SESSION_TURNS; the RUNBOOK's first remedy for
+    # a superseded-dominated waste rate)
+    session_context_turns: int = 2
+    # LRU cap + idle TTL on tracked sessions (host memory bound; env
+    # TPU_RAG_LOOKAHEAD_SESSION_MAX / TPU_RAG_LOOKAHEAD_SESSION_TTL_S)
+    session_max: int = 256
+    session_ttl_s: float = 600.0
+
+
+@dataclass(frozen=True)
 class ResilienceConfig:
     """Admission control, deadlines, and failure-recovery knobs (ISSUE 4 —
     rag_llm_k8s_tpu/resilience/). Defaults are sized for one pod of the
@@ -553,6 +604,7 @@ class AppConfig:
     engine: EngineConfig = field(default_factory=EngineConfig)
     server: ServerConfig = field(default_factory=ServerConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    lookahead: LookaheadConfig = field(default_factory=LookaheadConfig)
     system_message: str = SYSTEM_MESSAGE
 
     @classmethod
@@ -716,7 +768,40 @@ class AppConfig:
         _res_float("TPU_RAG_BREAKER_WINDOW_S", "breaker_window_s", 1.0)
         _res_int("TPU_RAG_INFLIGHT_RETRIES", "inflight_retries", 0)
         _res_float("TPU_RAG_RETRY_BACKOFF_MS", "retry_backoff_ms", 0.0)
+        lookahead = cfg.lookahead
+
+        def _la_flag(var: str, field_name: str):
+            nonlocal lookahead
+            if var in env:
+                flag = env[var]
+                if flag not in ("0", "1"):
+                    raise ValueError(f"{var}={flag!r}: expected '0' or '1'")
+                lookahead = dataclasses.replace(
+                    lookahead, **{field_name: flag == "1"}
+                )
+
+        def _la_num(var: str, field_name: str, minimum, cast):
+            nonlocal lookahead
+            if var in env:
+                v = cast(env[var])
+                if v < minimum:
+                    raise ValueError(f"{var}={v}: expected >= {minimum}")
+                lookahead = dataclasses.replace(lookahead, **{field_name: v})
+
+        _la_flag("TPU_RAG_LOOKAHEAD", "enabled")
+        _la_flag("TPU_RAG_LOOKAHEAD_PRESTAGE", "prestage_kv")
+        _la_flag("TPU_RAG_LOOKAHEAD_SESSIONS", "session_pipelining")
+        _la_num("TPU_RAG_LOOKAHEAD_WORKERS", "max_workers", 1, int)
+        _la_num("TPU_RAG_LOOKAHEAD_INFLIGHT", "max_inflight", 1, int)
+        _la_num("TPU_RAG_LOOKAHEAD_TTL_S", "ttl_s", 0.1, float)
+        _la_num(
+            "TPU_RAG_LOOKAHEAD_SESSION_TURNS", "session_context_turns", 1, int
+        )
+        _la_num("TPU_RAG_LOOKAHEAD_SESSION_MAX", "session_max", 1, int)
+        _la_num(
+            "TPU_RAG_LOOKAHEAD_SESSION_TTL_S", "session_ttl_s", 1.0, float
+        )
         return dataclasses.replace(
             cfg, server=server, mesh=mesh, sampling=sampling, engine=engine,
-            resilience=resilience,
+            resilience=resilience, lookahead=lookahead,
         )

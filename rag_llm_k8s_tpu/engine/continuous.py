@@ -182,6 +182,17 @@ class ContinuousEngine:
             # fragmentation gauge's scrape-thread callback reads this ONE
             # int instead of iterating the dict the scheduler mutates
             self._registered_tokens = 0
+            # admissions that mapped a registration's shared blocks since it
+            # was (re-)registered — release_prestaged(only_unused=True)
+            # keeps a registration live traffic has proven hot
+            self._prefix_uses: Dict[object, int] = {}
+            # registration GENERATION per chain key: a deferred lookahead
+            # release presents the generation it staged, so it can never
+            # free a registration a later admission re-created at the same
+            # key (uses resets to 0 on re-registration — the counter alone
+            # can't tell the two apart)
+            self._prefix_reg_gen: Dict[object, int] = {}
+            self._reg_seq = 0
             self._admit_seq = 0
             self._preempted: List[Tuple[int, List[int]]] = []
             self._blocks_at_retire: Dict[int, int] = {}
@@ -401,6 +412,8 @@ class ContinuousEngine:
             self._tables_dirty = True
             self._slot_blocks = [[] for _ in range(self.B)]
             self._prefix_blocks.clear()
+            self._prefix_uses.clear()
+            self._prefix_reg_gen.clear()
             self._registered_tokens = 0
             # pending preemption records describe PRE-reset slots; the reset
             # recovery resubmits every in-flight request itself, so replaying
@@ -731,6 +744,7 @@ class ContinuousEngine:
             entry = self._prefix_blocks.get(key)
             if entry is not None and entry[2] == plen:
                 shared_ids = list(entry[0])
+                self._prefix_uses[key] = self._prefix_uses.get(key, 0) + 1
         covered = len(shared_ids)
         need_total = self.kv_pool.blocks_for(max(total, 1))
         priv = self.kv_pool.alloc(need_total - covered)  # PoolExhausted → caller
@@ -768,14 +782,8 @@ class ContinuousEngine:
         if key is not None and not shared_ids and full_n > 0:
             reg = ids_all[:full_n]
             self.kv_pool.ref(reg)  # the cache's own ref outlives the row
-            self._prefix_blocks[key] = (list(reg), full_n * bs, plen)
-            self._registered_tokens += full_n * bs
+            self._register_prefix(key, reg, plen)
             shared_tok = full_n * bs  # now registration-counted, not row-counted
-            while len(self._prefix_blocks) > 8:  # bounded registration set
-                old_key = next(iter(self._prefix_blocks))
-                old_ids, old_cov, _ = self._prefix_blocks.pop(old_key)
-                self._registered_tokens -= old_cov
-                self.kv_pool.free(old_ids)
 
         tok0 = int(np.asarray(tok0s)[0])
         self._kv_len = self._kv_len.at[row].set(total)
@@ -799,6 +807,118 @@ class ContinuousEngine:
         )
         self.stats.decode_tokens += 1
         return row, None
+
+    def prestage_prefix(self, prefix) -> "str | bool":
+        """Warm a ``CachedPrefix``'s full blocks into POOL blocks ahead of
+        any admission (the lookahead pipeline's paged leg — rag/lookahead):
+        allocate ``length // block_size`` blocks, scatter the prefix planes
+        into them, and REGISTER them under the chain key, so the first
+        admission with this prompt head maps them copy-free instead of
+        scattering — exactly the sharing ``_admit_prefixed_paged`` sets up
+        on a first sighting, moved off the request path.
+
+        Must be called from the engine's owning (dispatcher) thread —
+        ``ContinuousScheduler.run_on_engine`` is the safe entry. Headroom-
+        gated: never takes blocks unless a full row's growth stays free, so
+        pre-staging cannot starve live admissions. Returns ``"registered"``
+        when THIS call created the registration (the caller owns the later
+        release), ``"resident"`` when it already existed (an earlier
+        admission or prestage owns it — never release someone else's), and
+        False when nothing was staged."""
+        if not self.paged:
+            return False
+        key = getattr(prefix, "chain_key", None)
+        if key is None:  # "slot"-mode prefixes are not content-identical
+            return False
+        pc = getattr(self.engine_config, "prefix_cache", None)
+        if pc is None or prefix.capacity != pc.max_prefix_tokens:
+            return False
+        bs = self.block_size
+        P = int(prefix.capacity)
+        plen = int(prefix.length)
+        full_n = plen // bs
+        if P % bs or full_n <= 0 or full_n > P // bs:
+            return False
+        entry = self._prefix_blocks.get(key)
+        if entry is not None and entry[2] == plen:
+            return "resident"  # earlier admission or prestage owns it
+        if not self.kv_pool.can_alloc(full_n + self.MB):
+            return False  # headroom: live traffic keeps a full row's growth
+        ids = self.kv_pool.alloc(full_n)
+        nbp = P // bs
+        scatter_ids = np.zeros((nbp,), np.int32)
+        scatter_ids[:full_n] = ids
+        try:
+            self._cache = self._get("prefix_scatter", P, 0)(
+                self._cache, tuple(self._put(p) for p in prefix.planes),
+                self._put(jnp.asarray(scatter_ids)),
+            )
+        except BaseException as e:  # noqa: BLE001 — donated arena invalidated
+            self.reset()  # reset() reclaims ids with everything else
+            raise EngineStateLost(
+                "prefix prestage failed; engine state reset"
+            ) from e
+        # alloc()'s ref IS the registration ref (no row holds these yet) —
+        # every reclaim path goes through _drop_registration, so
+        # registrations free exactly once
+        self._register_prefix(key, ids, plen)
+        return "registered"
+
+    def prestage_gen(self, chain_key):
+        """The live registration generation for a chain (None when not
+        registered) — a deferred release records it at staging time and
+        presents it back (``release_prestaged(gen=)``), so it can never
+        free a registration a later admission re-created at the same key.
+        Same thread contract as ``prestage_prefix``."""
+        return self._prefix_reg_gen.get(chain_key)
+
+    def _register_prefix(self, key, ids, plen: int) -> int:
+        """Register a chain's full blocks for future copy-free sharing and
+        return the registration generation; enforces the bounded-8 set.
+        The caller has already taken the registration's pool ref."""
+        self._reg_seq += 1
+        cov = len(ids) * self.block_size
+        self._prefix_blocks[key] = (list(ids), cov, plen)
+        self._prefix_uses[key] = 0
+        self._prefix_reg_gen[key] = self._reg_seq
+        self._registered_tokens += cov
+        while len(self._prefix_blocks) > 8:  # bounded registration set
+            self._drop_registration(next(iter(self._prefix_blocks)))
+        return self._reg_seq
+
+    def _drop_registration(self, key) -> bool:
+        """The one place a registration dies: pops every side table, fixes
+        the fragmentation counter, returns the blocks to the pool."""
+        entry = self._prefix_blocks.pop(key, None)
+        if entry is None:
+            return False
+        self._prefix_uses.pop(key, None)
+        self._prefix_reg_gen.pop(key, None)
+        ids, cov, _ = entry
+        self._registered_tokens -= cov
+        self.kv_pool.free(ids)
+        return True
+
+    def release_prestaged(self, chain_key, only_unused: bool = False,
+                          gen=None) -> bool:
+        """Stale-prefetch cancellation, pool side: drop one registered
+        chain and free its blocks (ref-count-correct — rows still decoding
+        over shared copies hold their own refs, so the pool only reclaims
+        the registration's). ``only_unused=True`` keeps a registration an
+        admission has mapped since it was staged — live traffic proved the
+        speculation right, so the lookahead release must not cost future
+        sharing. ``gen`` (from ``prestage_gen`` at staging time) guards the
+        deferred-release race: if the staged registration was evicted and a
+        later admission re-created one at this key, the generations differ
+        and the admission's registration survives. Same thread contract as
+        ``prestage_prefix``."""
+        if not self.paged:
+            return False
+        if gen is not None and self._prefix_reg_gen.get(chain_key) != gen:
+            return False  # a re-created registration owns this key now
+        if only_unused and self._prefix_uses.get(chain_key, 0) > 0:
+            return False
+        return self._drop_registration(chain_key)
 
     def _build_insert(self, S: int, n: int = 1):
         """Splice ``n`` freshly prefilled row blocks + their per-row state
@@ -1306,9 +1426,7 @@ class ContinuousEngine:
             # oldest registrations until the admission fits (cache refs are
             # re-buildable; a wedged queue is not)
             for key in list(self._prefix_blocks):
-                ids, cov, _ = self._prefix_blocks.pop(key)
-                self._registered_tokens -= cov
-                self.kv_pool.free(ids)
+                self._drop_registration(key)
                 if self.kv_pool.can_alloc(want):
                     return "ok"
         return "wait" if self.has_active() else (
@@ -1357,10 +1475,7 @@ class ContinuousEngine:
             # growth the registrations crowd out would preempt ITSELF in a
             # loop), then preempt the newest active row and retry
             if self._prefix_blocks:
-                old_key = next(iter(self._prefix_blocks))
-                old_ids, old_cov, _ = self._prefix_blocks.pop(old_key)
-                self._registered_tokens -= old_cov
-                self.kv_pool.free(old_ids)
+                self._drop_registration(next(iter(self._prefix_blocks)))
                 continue
             victims = [
                 (s.admit_seq, r) for r, s in enumerate(self.slots) if s.active
@@ -1904,6 +2019,23 @@ class ContinuousScheduler:
             info["kv_blocks_allocated"] = item.blocks_allocated
         return item.result
 
+    def run_on_engine(self, fn) -> bool:
+        """Enqueue a host-side engine task — ``fn(engine)`` — executed by
+        the dispatcher thread between admissions and steps. The engine is
+        single-owner (its step executables DONATE the device state), so
+        this is the only safe way for another thread (the lookahead
+        executor's KV pre-staging, rag/lookahead.py) to touch it. Fire and
+        forget; a task failure is contained exactly like a step failure
+        (EngineStateLost recovery resubmits the in-flight requests).
+        Returns False when the scheduler is shutting down."""
+        if not callable(fn):
+            raise TypeError("run_on_engine expects a callable(engine)")
+        with self._lifecycle_lock:
+            if self._stop.is_set():
+                return False
+            self._queue.put(fn)
+        return True
+
     def shutdown(self):
         from rag_llm_k8s_tpu.engine.batching import _join_worker
 
@@ -1920,7 +2052,7 @@ class ContinuousScheduler:
                     it = self._queue.get_nowait()
                 except queue.Empty:
                     break
-                if it is not None:
+                if it is not None and not callable(it):
                     it.error = RuntimeError("scheduler is shut down")
                     it.done.set()
 
@@ -1950,7 +2082,7 @@ class ContinuousScheduler:
                         queued = self._queue.get_nowait()
                     except queue.Empty:
                         break
-                    if queued is not None:
+                    if queued is not None and not callable(queued):
                         leftovers.append(queued)
             for it in leftovers:
                 it.error = err
@@ -1973,7 +2105,13 @@ class ContinuousScheduler:
                 item = self._queue.get()  # idle: block until work arrives
             while item is not None:  # admit everything that fits right now
                 if self._stop.is_set():
-                    return item  # un-acked: the finally drain fails it
+                    return item if not callable(item) else None
+                if callable(item):
+                    # engine task (lookahead pre-staging): host+one small
+                    # device call, run in arrival order between admissions
+                    self._run_engine_task(item, waiting)
+                    item = self._next_nowait()
+                    continue
                 if self._expire_queued(item):
                     # expired while queued: fail fast, never admit — under
                     # overload this is what keeps dead work off the device
@@ -2014,6 +2152,9 @@ class ContinuousScheduler:
                         break
                     if nxt is None:
                         break
+                    if callable(nxt):
+                        self._run_engine_task(nxt, waiting)
+                        continue
                     if self._expire_queued(nxt):
                         continue  # dead on arrival: no prefill for it
                     batch.append(nxt)
@@ -2169,6 +2310,25 @@ class ContinuousScheduler:
             it.retried = True
             self._m_retries.labels(outcome="resubmitted").inc()
             self._queue.put(it)
+
+    def _run_engine_task(self, task, waiting: Dict[int, "_Pending"]):
+        """Execute one enqueued engine task with step-grade containment: a
+        task that invalidates the donated device state (EngineStateLost
+        from a failed prestage scatter) recovers exactly like a failed
+        step — reset already happened inside the engine, the in-flight
+        requests resubmit from their prompts."""
+        try:
+            task(self.engine)
+        except EngineStateLost as e:
+            # the engine reset itself before raising: slots (and any
+            # emitted tokens) are gone — resubmit from the prompts
+            logger.exception(
+                "engine task reset the engine; recovering %d in-flight "
+                "request(s)", len(waiting),
+            )
+            self._handle_reset(e, waiting, extra=[], emitted={})
+        except BaseException:  # noqa: BLE001 — tasks must never kill the loop
+            logger.exception("engine task failed (engine state intact)")
 
     def _safe_step(self, waiting: Dict[int, "_Pending"]):
         """One decode step that can never kill the dispatcher: a device

@@ -79,6 +79,15 @@ class _Entry:
     seg_len: int  # real tokens (<= bucket)
     nbytes: int
     pinned: bool = False
+    # consumptions since creation (every resolve that HITS this entry bumps
+    # it) — lookahead staging records the creation-time value so a stale
+    # speculation releases ONLY blocks nothing else touched in between
+    uses: int = 0
+    # creation stamp (monotonic per cache, set by _insert): staging records
+    # it so a stale release never drops a DIFFERENT entry rebuilt at the
+    # same key after the staged one was budget-evicted (a fresh rebuild
+    # also starts at uses=0 — the use counter alone can't tell them apart)
+    stamp: int = 0
 
 
 def _planes_nbytes(planes: Tuple) -> int:
@@ -103,6 +112,13 @@ class PrefixCache:
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self._assembled: "OrderedDict[tuple, Tuple[Tuple, int]]" = OrderedDict()
+        # consumptions per assembled buffer since creation (keys ⊆
+        # _assembled) — same stale-release discipline as _Entry.uses
+        self._assembled_uses: Dict[tuple, int] = {}
+        # creation stamps for assembled buffers (keys ⊆ _assembled) — same
+        # identity discipline as _Entry.stamp
+        self._assembled_stamp: Dict[tuple, int] = {}
+        self._creation_seq = 0  # feeds both stamp tables
         self._pinned_keys: set = set()
         self.entry_bytes = 0
         self.assembled_bytes = 0
@@ -173,14 +189,18 @@ class PrefixCache:
         return out
 
     # -- the one public resolve/populate entry point ---------------------
-    def prefix_for(self, segments: Sequence[Tuple[str, Sequence[int]]]
-                   ) -> Optional[CachedPrefix]:
+    def prefix_for(self, segments: Sequence[Tuple[str, Sequence[int]]],
+                   _staged: Optional[Dict] = None) -> Optional[CachedPrefix]:
         """Resolve an ordered segment list ``[(key, token_ids), ...]`` into a
         spliced prefix buffer, building (and caching) any missing blocks —
         the miss path IS the populate path, so prefill work is never done
         twice for a slot-matched segment. Returns None when the prefix can't
         be represented (over the buffer capacity, or a single segment over
         the largest segment bucket) — the caller falls back to cold prefill.
+
+        ``_staged`` (``stage()``'s bookkeeping dict) collects which entry
+        keys / assembled buffer this call CREATED, so a stale speculation
+        can release exactly them later.
         """
         total = sum(len(ids) for _, ids in segments)
         P = self.config.max_prefix_tokens
@@ -196,16 +216,25 @@ class PrefixCache:
             memo = self._assembled.get(akey)
             if memo is not None:
                 self._assembled.move_to_end(akey)
+                self._assembled_uses[akey] = (
+                    self._assembled_uses.get(akey, 0) + 1
+                )
                 # touch member entries so the LRU order tracks real use
                 off, chain = 0, ()
                 for key, ids in segments:
                     ek = self._entry_key(key, off, chain)
-                    if ek in self._entries:
+                    e = self._entries.get(ek)
+                    if e is not None:
                         self._entries.move_to_end(ek)
+                        e.uses += 1
                     off += len(ids)
                     chain = chain + (key,)
                 self.hits += len(segments)
                 self.tokens_reused += total
+                if _staged is not None:
+                    _staged["chain_key"] = akey
+                    _staged["created"] = []
+                    _staged["memo_new"] = False
                 return CachedPrefix(
                     memo[0], memo[1], P, total, 0,
                     chain_key=akey if self.config.reuse == "exact" else None,
@@ -215,6 +244,7 @@ class PrefixCache:
         off = 0
         chain: Tuple[str, ...] = ()
         reused = computed = n_hit = n_miss = 0
+        created: List[tuple] = []  # (key, uses0, stamp) this resolve built
         for key, ids in segments:
             seg_len = len(ids)
             ek = self._entry_key(key, off, chain)
@@ -222,6 +252,7 @@ class PrefixCache:
                 e = self._entries.get(ek)
                 if e is not None and e.seg_len == seg_len:
                     self._entries.move_to_end(ek)
+                    e.uses += 1
                 else:
                     e = None  # slot/length mismatch: treat as a miss
             if e is None:
@@ -235,6 +266,13 @@ class PrefixCache:
                     pinned=key in self._pinned_keys,
                 )
                 self._insert(ek, e)
+                # staging identity is snapshotted HERE, at creation: uses
+                # is 0 by construction and stamp was just assigned under
+                # _insert's lock. Re-reading the entry at the end-of-resolve
+                # lock instead would let a concurrent hit (bumping uses
+                # between splices and that lock) erase the consumption
+                # evidence release_staged's uses-moved check depends on
+                created.append((ek, 0, e.stamp))
                 n_miss += 1
                 computed += seg_len
             else:
@@ -257,7 +295,15 @@ class PrefixCache:
             if prev is not None:
                 self.assembled_bytes -= _planes_nbytes(prev[0])
             self._assembled[akey] = (buf, off)
+            self._assembled_uses[akey] = 0
+            self._creation_seq += 1
+            self._assembled_stamp[akey] = self._creation_seq
             self.assembled_bytes += buf_bytes
+            if _staged is not None:
+                _staged["chain_key"] = akey
+                _staged["created"] = list(created)
+                _staged["memo_new"] = prev is None
+                _staged["memo_stamp"] = self._assembled_stamp[akey]
             # assembled buffers are full-capacity (P-wide) planes — at 8B
             # defaults ~512 MiB EACH — so they share the ONE HBM budget with
             # the segment blocks and, being pure re-splice avoidance, evict
@@ -273,17 +319,75 @@ class PrefixCache:
                     break
                 if k == akey:
                     continue
-                old_buf, _ = self._assembled.pop(k)
-                self.assembled_bytes -= _planes_nbytes(old_buf)
+                self._pop_assembled(k)
         return CachedPrefix(
             buf, off, P, reused, computed,
             chain_key=akey if self.config.reuse == "exact" else None,
         )
 
+    # -- lookahead staging (rag/lookahead.py drives these) ---------------
+    def stage(self, segments: Sequence[Tuple[str, Sequence[int]]]):
+        """Resolve-and-track: exactly ``prefix_for`` (the miss path IS the
+        populate path), but returns ``(CachedPrefix, staging_record)`` where
+        the record names every entry/assembled buffer this call CREATED —
+        the handle a superseded speculation passes to ``release_staged``.
+        Blocks another request consumed in the meantime are NOT released
+        (their ``uses`` moved past the recorded creation value)."""
+        record: Dict = {}
+        cp = self.prefix_for(segments, _staged=record)
+        if cp is None or not record:
+            return cp, None
+        return cp, record
+
+    def release_staged(self, record: Optional[Dict]) -> int:
+        """Release what a staging created and nothing else consumed since:
+        ref-count-correct stale-prefetch cancellation (a shared entry — the
+        pinned head, or a chunk a live request hit after staging — stays;
+        so does anything REBUILT at a staged key after the staged object
+        was budget-evicted, via the creation-stamp identity check).
+        Returns the number of device buffers dropped."""
+        if not record:
+            return 0
+        released = 0
+        with self._lock:
+            for ek, uses0, stamp0 in record.get("created", ()):
+                e = self._entries.get(ek)
+                if (
+                    e is None or e.pinned
+                    or e.stamp != stamp0  # a different entry owns this key now
+                    or e.uses > uses0  # consumed since staging
+                ):
+                    continue
+                self._entries.pop(ek)
+                self.entry_bytes -= e.nbytes
+                released += 1
+            akey = record.get("chain_key")
+            if record.get("memo_new") and akey in self._assembled:
+                if (
+                    self._assembled_stamp.get(akey) == record.get("memo_stamp")
+                    and self._assembled_uses.get(akey, 0) <= 0
+                    and self._pop_assembled(akey)
+                ):
+                    released += 1
+        return released
+
     # -- LRU bookkeeping -------------------------------------------------
+    def _pop_assembled(self, key) -> bool:
+        """Drop one assembled buffer + its use/stamp side-table rows (the
+        one place all three stay consistent; lock held by the caller)."""
+        item = self._assembled.pop(key, None)
+        if item is None:
+            return False
+        self._assembled_uses.pop(key, None)
+        self._assembled_stamp.pop(key, None)
+        self.assembled_bytes -= _planes_nbytes(item[0])
+        return True
+
     def _insert(self, key, entry: _Entry) -> None:
         budget = int(self.config.hbm_budget_mb) * (1 << 20)
         with self._lock:
+            self._creation_seq += 1
+            entry.stamp = self._creation_seq
             old = self._entries.pop(key, None)
             if old is not None:
                 self.entry_bytes -= old.nbytes
@@ -295,8 +399,7 @@ class PrefixCache:
                 self._assembled
                 and self.entry_bytes + self.assembled_bytes > budget
             ):
-                _, (old_buf, _) = self._assembled.popitem(last=False)
-                self.assembled_bytes -= _planes_nbytes(old_buf)
+                self._pop_assembled(next(iter(self._assembled)))
             # then evict LRU-first until under budget; pinned blocks (the
             # head — reused by 100% of requests) are skipped, and the entry
             # just inserted is never its own eviction victim
@@ -314,5 +417,7 @@ class PrefixCache:
         with self._lock:
             self._entries.clear()
             self._assembled.clear()
+            self._assembled_uses.clear()
+            self._assembled_stamp.clear()
             self.entry_bytes = 0
             self.assembled_bytes = 0

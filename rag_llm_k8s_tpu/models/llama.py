@@ -33,7 +33,7 @@ import flax.linen as nn
 import flax.struct
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from rag_llm_k8s_tpu.core.config import DTypePolicy, LlamaConfig
 from rag_llm_k8s_tpu.ops.attention import (
@@ -176,6 +176,33 @@ def rope_cos_sin(
     """``positions [B, S] -> cos, sin [B, S, head_dim // 2]`` (fp32)."""
     phase = positions.astype(jnp.float32)[..., None] * inv_freqs[None, None, :]
     return jnp.cos(phase), jnp.sin(phase)
+
+
+def replicate_undividable_heads(t: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
+    """Pin ``[B, S, heads, hd]`` projections whose head count does NOT tile
+    the ``tp`` axis to an explicitly replicated layout.
+
+    The projection kernels shard their flat ``heads*hd`` output column axis
+    over ``tp`` whenever the byte count divides (parallel/sharding.py), so a
+    head count that doesn't tile the axis leaves the reshaped ``[B, S,
+    heads, hd]`` array sharded at SUB-HEAD granularity. That layout is not
+    just slow — on this container's jax 0.4.x, GSPMD miscompiles the
+    slice+concat composite RoPE's rotate-by-halves builds over it whenever a
+    second mesh axis (``dp``) is also populated: the jitted forward returns
+    wrong VALUES (~0.3 absolute on tiny-config logits; eager is exact).
+    tests/test_quant.py::TestQuantTP::test_rope_headcut_sharding_is_exact
+    pins the miscompile shape. Heads that don't tile ``tp`` were never
+    meaningfully sharded anyway — degrade them to replicated, the same rule
+    ``_fit_spec`` applies to param dims. Head counts that DO tile the axis
+    (every production config) never reach the constraint."""
+    if mesh is None or "tp" not in mesh.axis_names:
+        return t
+    tp = mesh.shape["tp"]
+    if tp <= 1 or t.shape[2] % tp == 0:
+        return t
+    return jax.lax.with_sharding_constraint(
+        t, NamedSharding(mesh, P(None, None, None, None))
+    )
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
@@ -607,6 +634,11 @@ class Attention(nn.Module):
             q = dense(H * hd, "wq")(x).reshape(B, S, H, hd)
             k = dense(K * hd, "wk")(x).reshape(B, S, K, hd)
             v = dense(K * hd, "wv")(x).reshape(B, S, K, hd)
+        # head counts that don't tile tp must not stay sharded mid-head
+        # through RoPE's slice+concat (see replicate_undividable_heads)
+        q = replicate_undividable_heads(q, self.mesh)
+        k = replicate_undividable_heads(k, self.mesh)
+        v = replicate_undividable_heads(v, self.mesh)
 
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)

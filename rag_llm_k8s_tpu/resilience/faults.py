@@ -51,8 +51,15 @@ __all__ = [
 #   decode_step  — the continuous engine's decode step (device fault mid-
 #                  generation — the recovery/resubmit path's trigger);
 #   generate     — the one-shot engine's generate call (coalesce-mode
-#                  equivalent of decode_step).
-SITES = ("store_lookup", "embed", "insert", "decode_step", "generate")
+#                  equivalent of decode_step);
+#   lookahead_retrieve — the lookahead executor's worker-side retrieval
+#                  (rag/lookahead.py): a failed speculation must fall back
+#                  to the inline retrieve path and release everything it
+#                  staged — never fail the request.
+SITES = (
+    "store_lookup", "embed", "insert", "decode_step", "generate",
+    "lookahead_retrieve",
+)
 
 ENV_VAR = "TPU_RAG_FAULTS"
 

@@ -30,7 +30,8 @@ import math
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -43,6 +44,7 @@ from rag_llm_k8s_tpu.obs import logging as obs_logging
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
 from rag_llm_k8s_tpu.obs import slo as obs_slo
 from rag_llm_k8s_tpu.obs import tracing
+from rag_llm_k8s_tpu.rag import lookahead as lookahead_mod
 from rag_llm_k8s_tpu.rag.chunking import split_text
 from rag_llm_k8s_tpu.rag.pdf import extract_text
 from rag_llm_k8s_tpu.rag.prompt import assemble_context, assemble_prompt, extract_answer
@@ -238,6 +240,51 @@ class RagService:
                 llm_tokenizer, max(engine.engine_config.prompt_buckets)
             )
             store.attach_token_source(self._segment_source)
+        # retrieval lookahead (rag/lookahead.py): embed+KNN launches before
+        # the admission gate can queue a request and runs concurrently with
+        # in-flight decode; the serving tail JOINS the future. Sessions
+        # speculate turn N+1's retrieval while turn N decodes, and resolved
+        # retrievals pre-stage their chunk KV into the prefix cache / pool
+        # blocks. Env-gated (TPU_RAG_LOOKAHEAD), off by default.
+        self.lookahead = None
+        self._session_lock = threading.Lock()
+        self._sessions: "OrderedDict[str, Tuple[float, List[str]]]" = OrderedDict()
+        la_cfg = getattr(config, "lookahead", None)
+        if (
+            la_cfg is not None and la_cfg.enabled
+            and encoder is not None and store is not None
+        ):
+            from rag_llm_k8s_tpu.rag.lookahead import LookaheadExecutor
+
+            def _la_retrieve(text: str):
+                # the SAME entry points the sequential path uses — results
+                # (and therefore greedy streams) are identical by
+                # construction; coalesced, so lookahead embeds batch with
+                # live traffic's. TTL-bounded: a wedged coalescer worker
+                # must not pin the bounded lookahead pool forever (the
+                # surfaced TimeoutError fails the future; joiners fall
+                # back to inline retrieval) — a future older than the TTL
+                # is sweep-fodder anyway
+                if self.retrieve_coalescer is not None:
+                    return self.retrieve_coalescer.submit(
+                        text, timeout=float(la_cfg.ttl_s)
+                    )
+                return self._retrieve(text)
+
+            self.lookahead = LookaheadExecutor(
+                la_cfg,
+                retrieve_fn=_la_retrieve,
+                prestage_fn=self._lookahead_prestage,
+                release_fn=self._lookahead_release,
+                headroom_fn=self._lookahead_headroom,
+                index_gen_fn=lambda: self.store.ntotal,
+                # the service's registry from the start: binding the
+                # process-wide default first would permanently retain the
+                # first executor (and this whole service graph) in the
+                # default registry's inflight-gauge closure
+                registry=self.metrics,
+            )
+            self.lookahead.join_timeout_counter = self._m_join_timeouts
 
     # -- observability ---------------------------------------------------
     def _init_observability(self) -> None:
@@ -774,8 +821,128 @@ class RagService:
             resp["degraded_reasons"] = list(notes)
         return resp
 
+    # -- retrieval lookahead (rag/lookahead.py callbacks) ----------------
+    def _lookahead_headroom(self) -> bool:
+        """False while speculative lookahead work would pressure live
+        traffic: breaker open, requests already queued at the admission
+        gate, or (paged) a pool without a full row's worth of free blocks
+        — the service-side face of the engine's ``admission_state``
+        backpressure (the authoritative per-allocation gate runs on the
+        dispatcher thread inside ``prestage_prefix``)."""
+        if self.breaker.open:
+            return False
+        if self.admission.queue_depth() > 0:
+            return False
+        eng = getattr(self.scheduler, "engine", None)
+        pool = getattr(eng, "kv_pool", None)
+        if pool is not None:
+            # read-only probe (ints under the GIL): never steal the blocks
+            # the next admission's row growth needs
+            if not pool.can_alloc(getattr(eng, "MB", 1)):
+                return False
+        return True
+
+    def _lookahead_prestage(self, text: str, r):
+        """Executor-worker callback: the moment a lookahead retrieval
+        resolves, build/refresh the resolved chunks' segment KV into
+        prefix-cache entries (``PrefixCache.stage`` — the miss path IS the
+        populate path) and, on a paged continuous engine, register the
+        chain's full pool blocks ahead of admission
+        (``ContinuousEngine.prestage_prefix`` via ``run_on_engine`` — the
+        engine is single-owner). Returns the staging handle a superseded
+        speculation releases, or None when there is nothing to stage."""
+        if not self._prefix_enabled():
+            return None
+        if isinstance(r, tuple) and len(r) == 4 and r[0] == "__device__":
+            return None  # unfetched device handle: nothing host-side to key
+        results = r[0] if isinstance(r, tuple) else r
+        if not results:
+            return None
+        if not self._lookahead_headroom():
+            return None
+        ps = self._prompt_segments(text, results)
+        if ps is None:
+            return None
+        _, segments, _ = ps
+        cp, record = self.engine.prefix_cache.stage(segments)
+        if cp is None:
+            return None
+        handle = {"record": record, "chain_key": cp.chain_key, "pool": None}
+        sched = self.scheduler
+        eng = getattr(sched, "engine", None)
+        if (
+            cp.chain_key is not None
+            and getattr(eng, "paged", False)
+            and hasattr(sched, "run_on_engine")
+        ):
+            # the TASK records ownership: only the call that actually
+            # CREATED the registration may later release it ("resident"
+            # means an earlier admission/prestage owns it), and it records
+            # the registration GENERATION so the release can never free a
+            # registration re-created at this key after ours was evicted.
+            # A release task enqueued later runs after this one (FIFO on
+            # the dispatcher), so it reads the settled value.
+            def _prestage_task(e, _h=handle, _cp=cp):
+                if e.prestage_prefix(_cp) == "registered":
+                    _h["pool"] = e.prestage_gen(_cp.chain_key)
+
+            sched.run_on_engine(_prestage_task)
+        return handle
+
+    def _lookahead_release(self, handle: Dict) -> None:
+        """Stale-prefetch cancellation: release every prefix-cache entry /
+        assembled buffer / registered pool block a superseded speculation
+        staged and nothing else consumed (ref-count-correct on both
+        substrates — see ``PrefixCache.release_staged`` and
+        ``ContinuousEngine.release_prestaged``)."""
+        cache = getattr(self.engine, "prefix_cache", None)
+        if cache is not None:
+            cache.release_staged(handle.get("record"))
+        ck = handle.get("chain_key")
+        sched = self.scheduler
+        if ck is not None and hasattr(sched, "run_on_engine"):
+            # enqueue unconditionally: FIFO ordering after the prestage
+            # task means handle["pool"] (the staged generation) is settled
+            # when this runs; only_unused keeps a registration live traffic
+            # has mapped since staging, and the generation guard keeps one
+            # a later admission re-created (the speculation was right —
+            # releasing it would cost every future admission its copy-free
+            # share)
+            sched.run_on_engine(
+                lambda e: handle.get("pool") is not None
+                and e.release_prestaged(
+                    ck, only_unused=True, gen=handle["pool"]
+                )
+            )
+
+    def _session_note(self, session_id: str, prompt: str) -> str:
+        """Fold one turn's prompt into the session's conversation state and
+        return the speculative next-turn retrieval query (the trailing
+        turns joined — under topic coherence it retrieves the chunk set
+        turn N+1 is most likely to need). Sessions are LRU-capped and
+        TTL-swept host-side."""
+        lc = self.config.lookahead
+        now = time.monotonic()
+        with self._session_lock:
+            _, hist = self._sessions.pop(session_id, (now, []))
+            hist = (hist + [prompt])[-max(1, lc.session_context_turns):]
+            self._sessions[session_id] = (now, hist)
+            for k in list(self._sessions):
+                if k == session_id:
+                    continue
+                ts0, _ = self._sessions[k]
+                if (
+                    len(self._sessions) > lc.session_max
+                    or now - ts0 > lc.session_ttl_s
+                ):
+                    del self._sessions[k]
+                else:
+                    break  # ordered by recency: the rest are fresher
+            return " ".join(hist)
+
     def answer(
-        self, user_prompt: str, deadline: Optional[Deadline] = None
+        self, user_prompt: str, deadline: Optional[Deadline] = None,
+        session_id: Optional[str] = None,
     ) -> Dict:
         timings: Dict[str, float] = {}
         notes: List[str] = []  # degraded-path reasons (response + counter)
@@ -790,31 +957,84 @@ class RagService:
             # repurposing the old embed_ms/retrieve_ms split (which would
             # silently skew any cross-version comparison of stage timings)
             t0 = time.monotonic()
+            la = self.lookahead
+            fut = la.claim(user_prompt) if la is not None else None
+            r = None
             with tracing.span("retrieve") as retrieve_span:
-                # the wait side of the stage runs in THIS thread; the
-                # device work happens on the coalescer worker and its
-                # interior split re-attaches via _trace_retrieve below
-                if self.retrieve_coalescer is not None:
-                    # deadline-bounded: a wedged coalescer worker must not
-                    # pin this thread (and its admission slot) forever
+                if fut is not None:
+                    # lookahead pipeline: the retrieval was launched before
+                    # this request cleared admission — the critical path
+                    # pays only the JOIN (≈0 when it resolved during the
+                    # queue wait / other requests' decode)
+                    was_hit = fut.resolved()
                     try:
-                        r = self.retrieve_coalescer.submit(
-                            user_prompt,
-                            timeout=deadline.wait_timeout()
-                            if deadline is not None else None,
-                        )
-                    except TimeoutError:
+                        with tracing.span("lookahead_join"):
+                            r = la.join(
+                                fut,
+                                timeout=deadline.wait_timeout()
+                                if deadline is not None else None,
+                            )
+                    except lookahead_mod.JoinTimeout:
+                        # OUR wait expired — the request's own deadline
                         self._m_deadline.labels(stage="retrieve").inc()
                         raise DeadlineExceeded(
                             "retrieve",
                             deadline.budget_ms if deadline else None,
                         ) from None
-                else:
-                    r = self._retrieve(user_prompt)
+                    except Exception:  # noqa: BLE001 — speculation must not fail the request
+                        # includes a WORKER-side TimeoutError (bounded
+                        # coalescer submit): a failed speculation retrieves
+                        # inline, it never 504s a request whose own
+                        # deadline has budget left
+                        logger.warning(
+                            "lookahead retrieval failed; retrieving inline",
+                            exc_info=True,
+                        )
+                        r = None
+                    else:
+                        timings["lookahead_hit"] = 1.0 if was_hit else 0.0
+                        # the worker's tokenize never touched this thread:
+                        # the stage timing below is pure join wall-clock
+                        if isinstance(r, tuple) and len(r) == 4 \
+                                and r[0] == "__device__":
+                            r = (r[0], r[1], r[2], 0.0)
+                        elif isinstance(r, tuple) and len(r) == 2:
+                            r = (r[0], 0.0)
+                if r is None:
+                    if la is not None:
+                        la.note_miss()
+                    # the wait side of the stage runs in THIS thread; the
+                    # device work happens on the coalescer worker and its
+                    # interior split re-attaches via _trace_retrieve below
+                    if self.retrieve_coalescer is not None:
+                        # deadline-bounded: a wedged coalescer worker must
+                        # not pin this thread (and its admission slot)
+                        # forever
+                        try:
+                            r = self.retrieve_coalescer.submit(
+                                user_prompt,
+                                timeout=deadline.wait_timeout()
+                                if deadline is not None else None,
+                            )
+                        except TimeoutError:
+                            self._m_deadline.labels(stage="retrieve").inc()
+                            raise DeadlineExceeded(
+                                "retrieve",
+                                deadline.budget_ms if deadline else None,
+                            ) from None
+                    else:
+                        r = self._retrieve(user_prompt)
             with self._inflight_lock:
                 self._inflight_retrieve -= 1
             in_retrieve = False
             self._deadline_check(deadline, "retrieve")
+            if session_id and la is not None:
+                # multi-turn pipelining: speculate turn N+1's retrieval NOW
+                # so its embed+KNN (and KV pre-staging) overlap this turn's
+                # decode; superseded speculations release what they staged
+                spec_text = self._session_note(session_id, user_prompt)
+                if spec_text:
+                    la.speculate(session_id, spec_text)
 
             fused_r = (
                 r if isinstance(r, tuple) and len(r) == 4 and r[0] == "__device__"
@@ -1417,6 +1637,9 @@ class RagService:
         """Stop the serving threads (coalescers/schedulers) and release the
         store's device sidecar (the store may outlive this service; its HBM
         must not). Idempotent."""
+        if self.lookahead is not None:
+            # before the coalescer: lookahead workers submit into it
+            self.lookahead.shutdown()
         if self.retrieve_coalescer is not None:
             self.retrieve_coalescer.shutdown()
         if self.scheduler is not None:
@@ -1529,12 +1752,29 @@ class WsgiApp:
             parent_span_id=ctx.span_id if ctx else None,
         )
         trace_id, span_id = tr.trace_id, tr.span_id
+        la = self.service.lookahead
+        launched_fut = None
         try:
             data = request.get_json(force=True, silent=True) or {}
             user_prompt = data.get("prompt", "")
+            session_id = data.get("session_id")
+            if session_id is not None:
+                session_id = str(session_id)
             logger.debug("User query: %s", user_prompt)
             tr.attrs["prompt"] = user_prompt[:80]
             deadline, dl_err = self._request_deadline(data, request.headers)
+            if la is not None and user_prompt and dl_err is None:
+                # lookahead: start tokenize/embed+KNN NOW, before the
+                # admission gate can queue this request — under load the
+                # queue wait and other requests' decode hide the whole
+                # retrieval, and answer() merely joins the future. Keep the
+                # FUTURE (identity, not key): on shed, abandon releases it
+                # only when this was the last pre-admission waiter — a shed
+                # duplicate must not strand a concurrent request counting
+                # on the same future, or alias a newer one at the same text
+                launched_fut, _ = la.launch_tracked(
+                    user_prompt, trigger="admission", session_id=session_id
+                )
             if dl_err is not None:
                 status = 400
                 resp = self._jsonify({"error": dl_err}, 400)
@@ -1543,7 +1783,9 @@ class WsgiApp:
                 # modes): over-cap traffic sheds here in microseconds with
                 # 429/503 + Retry-After instead of queueing unboundedly
                 with self.service.admission.admit(deadline=deadline):
-                    body = self.service.answer(user_prompt, deadline=deadline)
+                    body = self.service.answer(
+                        user_prompt, deadline=deadline, session_id=session_id
+                    )
                 # access line while the trace is still current (formatter
                 # stamps trace_id/span_id from the contextvar)
                 access_logger.info(
@@ -1559,6 +1801,11 @@ class WsgiApp:
                     body["trace"] = tree
                 resp = self._jsonify(body)
         except AdmissionRejected as e:
+            if la is not None:
+                # the shed request lets go of its future; the LAST waiter
+                # letting go releases whatever it staged (counted as
+                # waste, not a leak). abandon(None) is a no-op.
+                la.abandon(launched_fut)
             status = e.status  # 429 = retry this pod; 503 = breaker/draining
             resp = self._jsonify(
                 {
@@ -1571,11 +1818,20 @@ class WsgiApp:
             )
             resp.headers["Retry-After"] = str(max(1, int(e.retry_after_s + 0.5)))
         except DeadlineExceeded as e:
+            if la is not None:
+                # a queue-stage expiry never claimed its future: let go, or
+                # under sustained overload unclaimed futures saturate the
+                # inflight bound and silently disable lookahead (abandon is
+                # a no-op on claimed/None futures, so post-claim stages and
+                # the no-lookahead path are unaffected)
+                la.abandon(launched_fut)
             status = 504
             resp = self._jsonify(
                 {"error": str(e), "stage": e.stage}, 504
             )
         except Exception as e:  # noqa: BLE001 — parity with rag.py:179-181
+            if la is not None:
+                la.abandon(launched_fut)  # same rule as the 504 path
             status = 500
             logger.exception("generate failed")
             resp = self._jsonify({"error": str(e)}, 500)

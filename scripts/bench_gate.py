@@ -51,6 +51,9 @@ REQUIRED_KEYS = (
     "paged_decode_steps_per_s.b64_paged",
     "paged_b64_speedup",
     "paged_tp.b8_steps_per_s",
+    # ISSUE 7: the lookahead overlapped-query leg's headline — a dropped
+    # leg must fail loudly, not read as "retrieval overlap unjudged"
+    "lookahead_overlap.query_p50_overlap_ms",
 )
 
 

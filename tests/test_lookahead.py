@@ -1,0 +1,1019 @@
+"""Retrieval lookahead pipeline (rag/lookahead.py + wiring).
+
+The load-bearing contracts:
+
+- **Byte identity**: greedy output streams are IDENTICAL with lookahead on
+  or off, sequential or overlapped — futures resolve through the same
+  retrieval entry points the sequential path uses (``make lookahead-smoke``
+  runs this file's smoke class in CI).
+- **Overlap**: the serving tail JOINS an already-launched future; a
+  resolved future costs ~0 on the critical path
+  (``timings["lookahead_hit"]``, ``rag_lookahead_joins_total{outcome}``).
+- **Stale-prefetch cancellation**: a superseded/expired/abandoned
+  speculation releases every prefix-cache entry, assembled buffer and pool
+  block it staged that nothing else consumed — zero leaks
+  (``PrefixCache.release_staged``, ``ContinuousEngine.release_prestaged``).
+- **Headroom gating**: speculative launches and pool pre-staging never
+  starve live traffic (breaker / admission queue / pool headroom).
+- **Fault containment**: a failed lookahead retrieval (armed
+  ``lookahead_retrieve`` site) falls back to inline retrieval — the
+  request never fails (the chaos lane re-runs this under make chaos).
+"""
+
+import dataclasses
+import io
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from rag_llm_k8s_tpu.core.config import (
+    AppConfig,
+    DTypePolicy,
+    EncoderConfig,
+    EngineConfig,
+    LlamaConfig,
+    LookaheadConfig,
+    PrefixCacheConfig,
+    SamplingConfig,
+)
+from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine
+from rag_llm_k8s_tpu.engine.encoder import EncoderRunner
+from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+from rag_llm_k8s_tpu.engine.prefix_cache import PrefixCache
+from rag_llm_k8s_tpu.index.store import VectorStore
+from rag_llm_k8s_tpu.models.bge_m3 import init_encoder_params
+from rag_llm_k8s_tpu.models.llama import init_llama_params
+from rag_llm_k8s_tpu.obs import metrics as obs_metrics
+from rag_llm_k8s_tpu.rag.lookahead import LookaheadExecutor
+from rag_llm_k8s_tpu.resilience import faults
+from rag_llm_k8s_tpu.server.app import RagService, create_app
+
+FP32 = DTypePolicy.fp32()
+
+
+class ByteTokenizer:
+    def encode(self, text):
+        return [b + 3 for b in text.encode("utf-8")]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return bytes((i - 3) % 256 for i in ids if i >= 3).decode("utf-8", "replace")
+
+
+def make_pdf(text: str) -> bytes:
+    content = f"BT /F1 12 Tf ({text}) Tj ET".encode()
+    return b"".join([
+        b"%PDF-1.4\n",
+        b"1 0 obj << /Type /Catalog /Pages 2 0 R >> endobj\n",
+        b"2 0 obj << /Type /Pages /Kids [3 0 R] /Count 1 >> endobj\n",
+        b"3 0 obj << /Type /Page /Parent 2 0 R /Contents 4 0 R "
+        b"/Resources << /Font << /F1 5 0 R >> >> >> endobj\n",
+        b"4 0 obj << /Length %d >> stream\n%s\nendstream endobj\n"
+        % (len(content), content),
+        b"5 0 obj << /Type /Font /Subtype /Type1 /BaseFont /Helvetica >> endobj\n",
+        b"%%EOF",
+    ])
+
+
+def _wait_for(pred, timeout=10.0, what="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# executor unit tests (stub callbacks — no models)
+# ---------------------------------------------------------------------------
+
+
+def _la_cfg(**kw):
+    base = dict(enabled=True, max_workers=2, max_inflight=4, ttl_s=30.0)
+    base.update(kw)
+    return LookaheadConfig(**base)
+
+
+class _Harness:
+    """Stub retrieval + staging substrate with controllable latency."""
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+        self.calls = []
+        self.staged = []
+        self.released = []
+        self.headroom = True
+        self.gen = 1
+
+    def retrieve(self, text):
+        if self.delay:
+            time.sleep(self.delay)
+        self.calls.append(text)
+        return ([f"result:{text}"], 0.5)
+
+    def prestage(self, text, result):
+        handle = {"text": text}
+        self.staged.append(handle)
+        return handle
+
+    def release(self, handle):
+        self.released.append(handle)
+
+    def executor(self, **cfg_kw):
+        return LookaheadExecutor(
+            _la_cfg(**cfg_kw),
+            retrieve_fn=self.retrieve,
+            prestage_fn=self.prestage,
+            release_fn=self.release,
+            headroom_fn=lambda: self.headroom,
+            index_gen_fn=lambda: self.gen,
+            # fresh registry per executor: the counter families are keyed by
+            # name, so binding the shared default registry would accumulate
+            # values across tests
+            registry=obs_metrics.MetricsRegistry(),
+        )
+
+
+class TestExecutor:
+    def test_launch_claim_join_hit(self):
+        h = _Harness()
+        ex = h.executor()
+        try:
+            fut = ex.launch("q1")
+            assert fut is not None
+            _wait_for(fut.resolved, what="future resolve")
+            claimed = ex.claim("q1")
+            assert claimed is fut
+            r = ex.join(claimed)
+            assert r == (["result:q1"], 0.5)
+            assert ex._m_joins["hit"].value == 1
+            # claimed future: nothing was prestaged for it to release
+            assert ex.claim("q1") is None  # consumed
+        finally:
+            ex.shutdown()
+
+    def test_join_on_running_future_counts_late(self):
+        h = _Harness(delay=0.2)
+        ex = h.executor()
+        try:
+            fut = ex.launch("slow")
+            claimed = ex.claim("slow")
+            assert claimed is fut and not fut.resolved()
+            r = ex.join(claimed, timeout=5.0)
+            assert r[0] == ["result:slow"]
+            assert ex._m_joins["late"].value == 1
+        finally:
+            ex.shutdown()
+
+    def test_launch_dedupes_by_key(self):
+        h = _Harness(delay=0.2)
+        ex = h.executor()
+        try:
+            a = ex.launch("same")
+            b = ex.launch("same")
+            assert a is b
+            assert ex._m_launched["admission"].value == 1
+        finally:
+            ex.shutdown()
+
+    def test_inflight_bound_skips(self):
+        h = _Harness(delay=0.5)
+        ex = h.executor(max_workers=1, max_inflight=2)
+        try:
+            assert ex.launch("a") is not None
+            assert ex.launch("b") is not None
+            assert ex.launch("c") is None  # over the bound: skipped, not queued
+            assert ex._m_skipped["inflight"].value == 1
+        finally:
+            ex.shutdown()
+
+    def test_speculative_launch_gates_on_headroom(self):
+        h = _Harness()
+        h.headroom = False
+        ex = h.executor()
+        try:
+            assert ex.speculate("s1", "next turn") is None
+            assert ex._m_skipped["headroom"].value == 1
+            # admission-trigger launches are NOT speculative: they always run
+            assert ex.launch("real request") is not None
+        finally:
+            ex.shutdown()
+
+    def test_new_speculation_supersedes_and_releases_old(self):
+        h = _Harness()
+        ex = h.executor()
+        try:
+            f1 = ex.speculate("s1", "turn two?")
+            _wait_for(lambda: f1.staging is not None, what="prestage")
+            f2 = ex.speculate("s1", "different turn two?")
+            assert f2 is not f1
+            _wait_for(lambda: len(h.released) == 1, what="stale release")
+            assert h.released[0]["text"] == "turn two?"
+            assert ex._m_wasted["superseded"].value == 1
+            assert ex._m_prestage_released.value == 1
+        finally:
+            ex.shutdown()
+
+    def test_ttl_expiry_releases_staging(self):
+        h = _Harness()
+        ex = h.executor(ttl_s=0.2)
+        try:
+            f = ex.launch("goes stale")
+            _wait_for(lambda: f.staging is not None, what="prestage")
+            time.sleep(0.3)
+            assert ex.sweep() == 1
+            assert ex._m_wasted["expired"].value == 1
+            _wait_for(lambda: len(h.released) == 1, what="expired release")
+        finally:
+            ex.shutdown()
+
+    def test_abandon_releases_staging(self):
+        h = _Harness()
+        ex = h.executor()
+        try:
+            f = ex.launch("shed by admission")
+            _wait_for(lambda: f.staging is not None, what="prestage")
+            ex.abandon(f)
+            assert ex._m_wasted["abandoned"].value == 1
+            _wait_for(lambda: len(h.released) == 1, what="abandon release")
+        finally:
+            ex.shutdown()
+
+    def test_abandon_waits_for_last_waiter(self):
+        """Two requests share one future (dedupe); the CREATOR is shed
+        first — the future must survive for the duplicate still counting
+        on it, and die only when the last waiter lets go."""
+        h = _Harness(delay=0.2)
+        ex = h.executor()
+        try:
+            a, created_a = ex.launch_tracked("shared")
+            b, created_b = ex.launch_tracked("shared")
+            assert a is b and created_a and not created_b
+            assert a.waiters == 2
+            ex.abandon(a)  # the creator is shed: one waiter remains
+            assert not a.superseded
+            claimed = ex.claim("shared")  # the duplicate still gets it
+            assert claimed is a
+            assert ex.join(claimed, timeout=5.0)[0] == ["result:shared"]
+            # both shed: the future dies exactly once
+            c, _ = ex.launch_tracked("both shed")
+            d, _ = ex.launch_tracked("both shed")
+            assert c is d
+            ex.abandon(c)
+            ex.abandon(c)
+            assert c.superseded
+            assert ex._m_wasted["abandoned"].value == 1
+        finally:
+            ex.shutdown()
+
+    def test_background_sweeper_expires_without_traffic(self):
+        """TTL enforcement must not depend on new launches: a future on a
+        service that goes quiet expires (and releases its staging) from
+        the sweeper thread alone."""
+        h = _Harness()
+        ex = h.executor(ttl_s=0.6)  # sweeper interval = ttl/2 = 0.3s
+        try:
+            f = ex.launch("quiet service")
+            _wait_for(lambda: f.staging is not None, what="prestage")
+            # NO further launches: only the background sweeper can expire it
+            _wait_for(
+                lambda: ex._m_wasted["expired"].value >= 1,
+                timeout=5.0, what="background expiry",
+            )
+            _wait_for(lambda: len(h.released) == 1, what="staging release")
+        finally:
+            ex.shutdown()
+
+    def test_deduped_launch_is_not_marked_created(self):
+        """Two concurrent requests with the identical prompt share ONE
+        future (waiters=2); a shed duplicate only drops its own waiter —
+        it must not strand the original request on an inline retrieval."""
+        h = _Harness(delay=0.2)
+        ex = h.executor()
+        try:
+            a, created_a = ex.launch_tracked("shared prompt")
+            b, created_b = ex.launch_tracked("shared prompt")
+            assert a is b and created_a and not created_b
+            # the duplicate was shed: its abandon drops one waiter and the
+            # future lives on, so the original still claims and joins it
+            ex.abandon(b)
+            claimed = ex.claim("shared prompt")
+            assert claimed is a
+            assert ex.join(claimed, timeout=5.0)[0] == ["result:shared prompt"]
+        finally:
+            ex.shutdown()
+
+    def test_expired_session_speculation_counts_waste_once(self):
+        """An expired session speculation dies exactly once: the sweep
+        counts it as ``expired`` and clears the session's registry slot,
+        so the session's NEXT speculation must not count (or release) the
+        same future again as ``superseded``."""
+        h = _Harness()
+        ex = h.executor(ttl_s=0.2)
+        try:
+            f1 = ex.speculate("s1", "turn two?")
+            _wait_for(lambda: f1.staging is not None, what="prestage")
+            time.sleep(0.3)
+            assert ex.sweep() == 1
+            f2 = ex.speculate("s1", "a different turn two?")
+            assert f2 is not None and f2 is not f1
+            assert ex._m_wasted["expired"].value == 1
+            assert ex._m_wasted["superseded"].value == 0
+            _wait_for(lambda: len(h.released) == 1, what="expired release")
+            assert len(h.released) == 1  # released once, not twice
+        finally:
+            ex.shutdown()
+
+    def test_stale_index_future_is_never_served(self):
+        h = _Harness()
+        ex = h.executor()
+        try:
+            f = ex.launch("pre-ingest query")
+            _wait_for(f.resolved, what="resolve")
+            h.gen = 2  # the index grew since launch
+            assert ex.claim("pre-ingest query") is None
+            assert ex._m_wasted["stale"].value == 1
+        finally:
+            ex.shutdown()
+
+    def test_join_wait_expiry_is_a_join_timeout(self):
+        """join()'s OWN wait expiring raises JoinTimeout (the caller's
+        deadline/504 path); a WORKER-side TimeoutError (bounded coalescer
+        submit) re-raises as plain TimeoutError — the caller's
+        inline-fallback path — and never as JoinTimeout."""
+        from rag_llm_k8s_tpu.rag.lookahead import JoinTimeout
+
+        h = _Harness(delay=0.5)
+        ex = h.executor(max_workers=1)
+        try:
+            ex.launch("slow")
+            claimed = ex.claim("slow")
+            with pytest.raises(JoinTimeout):
+                ex.join(claimed, timeout=0.01)
+        finally:
+            ex.shutdown()
+
+        def coalescer_wedged(text):
+            raise TimeoutError("coalescer submit timed out")
+
+        ex2 = LookaheadExecutor(
+            _la_cfg(), retrieve_fn=coalescer_wedged,
+            registry=obs_metrics.MetricsRegistry(),
+        )
+        try:
+            ex2.launch("wedged")
+            claimed = ex2.claim("wedged")
+            with pytest.raises(TimeoutError) as ei:
+                ex2.join(claimed, timeout=5.0)
+            assert not isinstance(ei.value, JoinTimeout)
+            # failed joins stay out of the launch-to-join histogram
+            assert ex2._m_join_wait.snapshot()[2] == 0
+        finally:
+            ex2.shutdown()
+
+    def test_injected_fault_surfaces_at_join_not_crash(self):
+        h = _Harness()
+        ex = h.executor()
+        try:
+            faults.arm("lookahead_retrieve", 1)
+            f = ex.launch("faulted")
+            claimed = ex.claim("faulted")
+            with pytest.raises(faults.InjectedFault):
+                ex.join(claimed, timeout=5.0)
+            assert ex._m_wasted["failed"].value == 1
+            # the executor stays healthy: the next launch serves normally
+            f2 = ex.launch("after fault")
+            assert ex.join(ex.claim("after fault"), timeout=5.0)[0] == \
+                ["result:after fault"]
+        finally:
+            faults.clear()
+            ex.shutdown()
+
+    def test_shutdown_fails_claimed_queued_future_fast(self):
+        """A CLAIMED future still queued behind a busy worker is no longer
+        in the registry — shutdown must fail it from the queue drain, so a
+        request blocked in join() errors fast (and falls back inline)
+        instead of stalling out its whole deadline."""
+        h = _Harness(delay=0.3)
+        ex = h.executor(max_workers=1)
+        ex.launch("busy")  # occupies the only worker
+        b = ex.launch("queued behind")
+        claimed = ex.claim("queued behind")
+        assert claimed is b and not b.resolved()
+        ex.shutdown()
+        with pytest.raises(RuntimeError):
+            ex.join(claimed, timeout=1.0)
+
+    def test_speculative_dedupe_replaces_previous_speculation(self):
+        """A speculative launch that DEDUPES onto an existing future still
+        honors speculate()'s replace-and-release contract: the session's
+        previous speculation is superseded (waste reason 'superseded', not
+        a delayed 'expired'), and the slot follows the shared future."""
+        h = _Harness()
+        ex = h.executor()
+        try:
+            f_old = ex.speculate("s1", "old topic")
+            _wait_for(lambda: f_old.staging is not None, what="prestage")
+            f_other = ex.speculate("s2", "shared next topic")
+            f_new = ex.speculate("s1", "shared next topic")  # dedupe
+            assert f_new is f_other
+            _wait_for(
+                lambda: ex._m_wasted["superseded"].value >= 1,
+                what="old speculation superseded",
+            )
+            assert f_old.superseded
+            assert ex._session_spec["s1"] is f_other
+        finally:
+            ex.shutdown()
+
+    def test_shutdown_releases_outstanding_staging(self):
+        h = _Harness()
+        ex = h.executor()
+        f = ex.launch("unconsumed")
+        _wait_for(lambda: f.staging is not None, what="prestage")
+        ex.shutdown()
+        assert len(h.released) == 1
+        assert ex.launch("post-shutdown") is None
+
+
+# ---------------------------------------------------------------------------
+# prefix-cache staging (stub engine — LRU bookkeeping only)
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    def __init__(self, block_bytes=1 << 20):
+        self.block_bytes = block_bytes
+
+    def prefix_buffer_zero(self):
+        return (np.zeros(1, np.int8),)
+
+    def build_segment_kv(self, ids, ctx, off):
+        return (np.zeros(self.block_bytes, np.int8),)
+
+    def splice_prefix(self, buf, block, off):
+        return buf
+
+
+def _pc_cfg(**kw):
+    base = dict(
+        enabled=True, max_prefix_tokens=4096, segment_buckets=(64, 2048),
+        suffix_buckets=(128,), hbm_budget_mb=64, assembled_cache_entries=8,
+    )
+    base.update(kw)
+    return PrefixCacheConfig(**base)
+
+
+class TestPrefixCacheStaging:
+    def test_release_staged_drops_exactly_what_staging_created(self):
+        cache = PrefixCache(_pc_cfg(), _StubEngine(block_bytes=64))
+        head = [("head", list(range(8)))]
+        cache.prefix_for(head + [("chunk:live", list(range(16)))])
+        bytes_before = cache.counters()["prefix_cache_bytes"]
+        entries_before = len(cache._entries)
+
+        cp, record = cache.stage(head + [("chunk:spec", list(range(16)))])
+        assert cp is not None and record is not None
+        # the head entry pre-existed: only the speculative chunk is new
+        assert len(record["created"]) == 1
+        released = cache.release_staged(record)
+        assert released >= 2  # the chunk entry + the new assembled buffer
+        assert len(cache._entries) == entries_before
+        assert cache.counters()["prefix_cache_bytes"] == bytes_before
+
+    def test_consumed_staging_is_not_released(self):
+        cache = PrefixCache(_pc_cfg(), _StubEngine(block_bytes=64))
+        segs = [("head", list(range(8))), ("chunk:s", list(range(16)))]
+        cp, record = cache.stage(segs)
+        # a real request consumed the staged chain before it went stale
+        cache.prefix_for(segs)
+        assert cache.release_staged(record) == 0
+        assert any(k[0] == "chunk:s" for k in cache._entries)
+
+    def test_consumption_during_resolve_is_not_released(self):
+        """A hit landing between an entry's creation and the resolve's
+        end-of-staging bookkeeping must still count as consumption: the
+        staging identity is snapshotted at CREATION (uses=0), so the
+        release keeps an entry another request started reusing mid-resolve
+        (snapshotting at the end would absorb the bump into uses0 and
+        erase the evidence)."""
+        cache_ref = []
+
+        class _MidResolveHit(_StubEngine):
+            calls = 0
+
+            def build_segment_kv(self, ids, ctx, off):
+                self.calls += 1
+                if self.calls == 2:  # building B: A created, resolve open
+                    cache_ref[0].prefix_for([("A", list(range(8)))])
+                return super().build_segment_kv(ids, ctx, off)
+
+        cache = PrefixCache(_pc_cfg(), _MidResolveHit(block_bytes=64))
+        cache_ref.append(cache)
+        cp, record = cache.stage(
+            [("A", list(range(8))), ("B", list(range(16)))]
+        )
+        assert record is not None and len(record["created"]) == 2
+        cache.release_staged(record)
+        assert any(k[0] == "A" for k in cache._entries)  # consumed: kept
+        assert not any(k[0] == "B" for k in cache._entries)  # stale: gone
+
+    def test_pinned_entries_survive_release(self):
+        cache = PrefixCache(_pc_cfg(), _StubEngine(block_bytes=64))
+        cache.pin("head")
+        cp, record = cache.stage([("head", list(range(8)))])
+        cache.release_staged(record)
+        assert any(k[0] == "head" for k in cache._entries)
+
+    def test_release_staged_skips_entries_rebuilt_after_eviction(self):
+        """Creation-stamp identity: if the STAGED entry was budget-evicted
+        and a live request rebuilt a fresh entry at the same key (a rebuild
+        also starts at uses=0), the stale release must keep the rebuild —
+        the use counter alone cannot tell the two apart."""
+        cache = PrefixCache(_pc_cfg(), _StubEngine(block_bytes=64))
+        segs = [("chunk:reborn", list(range(16)))]
+        cp, record = cache.stage(segs)
+        assert record is not None and len(record["created"]) == 1
+        cache.clear()  # the staged entry + memo fall to budget pressure
+        cache.prefix_for(segs)  # a live request rebuilds at the same key
+        bytes_live = cache.counters()["prefix_cache_bytes"]
+        assert bytes_live > 0
+        assert cache.release_staged(record) == 0
+        assert cache.counters()["prefix_cache_bytes"] == bytes_live
+        assert any(k[0] == "chunk:reborn" for k in cache._entries)
+
+    def test_stage_of_fully_cached_chain_creates_nothing(self):
+        cache = PrefixCache(_pc_cfg(), _StubEngine(block_bytes=64))
+        segs = [("head", list(range(8))), ("chunk:c", list(range(16)))]
+        cache.prefix_for(segs)
+        cp, record = cache.stage(segs)  # memo hit
+        assert cp.computed_tokens == 0
+        assert record is not None and record["created"] == [] \
+            and not record["memo_new"]
+        before = cache.counters()["prefix_cache_bytes"]
+        assert cache.release_staged(record) == 0
+        assert cache.counters()["prefix_cache_bytes"] == before
+
+
+# ---------------------------------------------------------------------------
+# paged pool pre-staging (ContinuousEngine)
+# ---------------------------------------------------------------------------
+
+
+PC = PrefixCacheConfig(
+    enabled=True, max_prefix_tokens=48, segment_buckets=(16,),
+    suffix_buckets=(16,), hbm_budget_mb=64,
+)
+
+
+class TestPoolPrestage:
+    @pytest.fixture(scope="class")
+    def px(self):
+        cfg = LlamaConfig.tiny(vocab_size=128)
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, FP32)
+        ec = EngineConfig(
+            prompt_buckets=(64,), max_batch_size=2, speculative="off",
+            max_seq_len=128, prefix_cache=PC,
+        )
+        engine = InferenceEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=6),
+            engine_config=ec, dtypes=FP32,
+        )
+        cont = ContinuousEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=6),
+            engine_config=dataclasses.replace(
+                ec, kv_paged=True, kv_block_size=16
+            ),
+            dtypes=FP32,
+        )
+        return cfg, engine, cont
+
+    def _drain(self, cont, rid, fin):
+        outs = {}
+        while cont.has_active():
+            for r, toks in cont.step():
+                outs[r] = toks
+        return fin if fin is not None else outs[rid]
+
+    def test_prestage_registers_blocks_and_admission_shares_them(self, px):
+        """Pre-staging scatters the chain's full blocks into the pool ahead
+        of ANY admission; the first prefixed admission then maps them
+        copy-free (zero fresh allocations for the shared span) with greedy
+        parity vs a plain full-prompt admission."""
+        cfg, engine, cont = px
+        rng = np.random.default_rng(11)
+        head = [cfg.bos_token_id] + list(map(int, rng.integers(3, 120, 15)))
+        chunk = list(map(int, rng.integers(3, 120, 16)))
+        segments = [("head:la", head), ("chunk:la", chunk)]
+        suffix = list(map(int, rng.integers(3, 120, 6)))
+        cp = engine.prefix_cache.prefix_for(segments)
+        assert cp.chain_key is not None and cp.length == 32
+
+        base_in_use = cont.kv_pool.blocks_in_use()
+        assert cont.prestage_prefix(cp) == "registered"
+        registered = cp.length // cont.block_size
+        assert cont.kv_pool.blocks_in_use() == base_in_use + registered
+        # idempotent — and "resident" marks the OTHER owner, so a second
+        # speculation never claims (and later releases) this registration
+        assert cont.prestage_prefix(cp) == "resident"
+
+        allocs_before = cont.kv_pool.total_allocs
+        _, fin = cont.admit_prefixed(1, suffix, cp, max_new=6)
+        got = self._drain(cont, 1, fin)
+        # the shared span allocated NOTHING fresh — only tail/suffix/growth
+        fresh = cont.kv_pool.total_allocs - allocs_before
+        assert fresh < cont.kv_pool.blocks_for(cp.length + len(suffix))
+        full = [t for _, seg in segments for t in seg] + suffix
+        _, fin2 = cont.admit(2, full, max_new=6)
+        assert got == self._drain(cont, 2, fin2)
+
+        # the admission above MAPPED the registration: an only_unused
+        # release (the lookahead's stale path) keeps it — live traffic
+        # proved the speculation right
+        assert cont.release_prestaged(cp.chain_key, only_unused=True) is False
+        assert cont.kv_pool.blocks_in_use() == base_in_use + registered
+        # unconditional stale-prefetch cancellation: the blocks return
+        assert cont.release_prestaged(cp.chain_key) is True
+        assert cont.kv_pool.blocks_in_use() == base_in_use
+        assert cont.release_prestaged(cp.chain_key) is False  # idempotent
+
+    def test_stale_gen_release_keeps_recreated_registration(self, px):
+        """Registration-generation identity: a deferred lookahead release
+        presenting the generation it staged must NOT free a registration
+        that was evicted and re-created at the same chain key since —
+        the re-creation belongs to live traffic (uses resets to 0 on
+        re-registration, so only the generation can tell them apart)."""
+        cfg, engine, cont = px
+        rng = np.random.default_rng(17)
+        head = [cfg.bos_token_id] + list(map(int, rng.integers(3, 120, 15)))
+        segments = [("head:gen", head), ("chunk:gen", list(map(int, rng.integers(3, 120, 16))))]
+        cp = engine.prefix_cache.prefix_for(segments)
+        assert cont.prestage_prefix(cp) == "registered"
+        gen1 = cont.prestage_gen(cp.chain_key)
+        assert gen1 is not None
+        # pressure evicts the staged registration, then it is re-created
+        assert cont.release_prestaged(cp.chain_key) is True
+        assert cont.prestage_prefix(cp) == "registered"
+        gen2 = cont.prestage_gen(cp.chain_key)
+        assert gen2 != gen1
+        in_use = cont.kv_pool.blocks_in_use()
+        # the stale deferred release (old generation) must be a no-op
+        assert cont.release_prestaged(
+            cp.chain_key, only_unused=True, gen=gen1
+        ) is False
+        assert cont.kv_pool.blocks_in_use() == in_use
+        # the current owner still releases cleanly
+        assert cont.release_prestaged(cp.chain_key, gen=gen2) is True
+        assert cont.kv_pool.blocks_in_use() < in_use
+
+    def test_prestage_respects_pool_headroom(self, px):
+        """A pool without a full row's growth headroom refuses to pre-stage
+        (live admissions keep their blocks) — the admission_state
+        backpressure, applied to speculation."""
+        cfg, engine, cont = px
+        rng = np.random.default_rng(13)
+        head = [cfg.bos_token_id] + list(map(int, rng.integers(3, 120, 15)))
+        segments = [("head:tight", head)]
+        cp = engine.prefix_cache.prefix_for(segments)
+        tight = ContinuousEngine(
+            cfg, engine.params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=6),
+            engine_config=dataclasses.replace(
+                engine.engine_config, kv_paged=True, kv_block_size=16,
+                # exactly one row's worth (MB=8): valid construction, but
+                # prestage needs full_n + MB free — refused, zero taken
+                kv_pool_blocks=8,
+            ),
+            dtypes=FP32,
+        )
+        assert tight.prestage_prefix(cp) is False  # no headroom: skipped
+        assert tight.kv_pool.blocks_in_use() == 0
+
+
+# ---------------------------------------------------------------------------
+# service-level: byte identity, session pipelining, fault fallback
+# ---------------------------------------------------------------------------
+
+
+SERVICE_PC = PrefixCacheConfig(
+    enabled=True, max_prefix_tokens=512, segment_buckets=(64, 128, 256),
+    suffix_buckets=(128,), hbm_budget_mb=64,
+)
+
+
+def build_service(tmp, lookahead: bool, prefix_cache: bool = False,
+                  ttl_s: float = 30.0):
+    llama_cfg = LlamaConfig.tiny(vocab_size=300)
+    enc_cfg = EncoderConfig.tiny(vocab_size=300)
+    ec_kw = {}
+    if prefix_cache:
+        ec_kw["prefix_cache"] = SERVICE_PC
+    cfg = AppConfig(
+        model=llama_cfg, encoder=enc_cfg, system_message="sys",
+        lookahead=LookaheadConfig(enabled=lookahead, ttl_s=ttl_s),
+    )
+    engine = InferenceEngine(
+        llama_cfg, init_llama_params(jax.random.PRNGKey(0), llama_cfg, FP32),
+        sampling=SamplingConfig(do_sample=False, max_new_tokens=8),
+        engine_config=EngineConfig(
+            prompt_buckets=(128, 512), max_batch_size=2, speculative="off",
+            **ec_kw,
+        ),
+        dtypes=FP32,
+    )
+    encoder = EncoderRunner(
+        enc_cfg, init_encoder_params(jax.random.PRNGKey(1), enc_cfg, FP32),
+        dtypes=FP32, length_buckets=(32, 64), max_batch=4,
+    )
+    # the path is a FILE path (save() writes tmp-then-rename onto it) —
+    # never hand it an existing directory like pytest's tmp_path
+    store = VectorStore(dim=enc_cfg.hidden_size, path=str(tmp / "store.idx"))
+    svc = RagService(cfg, engine, ByteTokenizer(), encoder, ByteTokenizer(), store)
+    svc.ready = True
+    return svc, create_app(svc).test_client()
+
+
+CORPUS = make_pdf(
+    "TPU retrieval systems use interchip links for collectives and reach "
+    "high decode throughput with paged caches"
+)
+
+QUERIES = [
+    "what links do TPUs use?",
+    "how fast is decode?",
+    "what about paged caches?",
+    "tell me about collectives",
+]
+
+
+@pytest.fixture(scope="module")
+def smoke_pair(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("la")
+    svc_off, c_off = build_service(tmp / "off", lookahead=False)
+    svc_on, c_on = build_service(tmp / "on", lookahead=True)
+    for c in (c_off, c_on):
+        r = c.post("/upload_pdf", data={"file": (io.BytesIO(CORPUS), "d.pdf")},
+                   content_type="multipart/form-data")
+        assert r.status_code == 200, r.get_data()
+    yield svc_off, c_off, svc_on, c_on
+    svc_on.shutdown()
+    svc_off.shutdown()
+
+
+class TestSmoke:
+    """``make lookahead-smoke``: sequential-vs-overlapped byte identity."""
+
+    def test_sequential_streams_byte_identical(self, smoke_pair):
+        svc_off, c_off, svc_on, c_on = smoke_pair
+        for q in QUERIES:
+            a = c_off.post("/query", json={"prompt": q}).get_json()
+            b = c_on.post("/query", json={"prompt": q, "session_id": "s0"}).get_json()
+            assert a["generated_text"] == b["generated_text"], q
+            assert "lookahead_hit" in b["timings"]
+
+    def test_concurrent_streams_byte_identical_and_overlapped(self, smoke_pair):
+        svc_off, c_off, svc_on, c_on = smoke_pair
+
+        def run_all(app_client_factory):
+            out = {}
+            lock = threading.Lock()
+
+            def worker(q):
+                c = app_client_factory()
+                r = c.post("/query", json={"prompt": q}).get_json()
+                with lock:
+                    out[q] = r
+
+            ths = [threading.Thread(target=worker, args=(q,)) for q in QUERIES]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            return out
+
+        from rag_llm_k8s_tpu.server.app import create_app as _ca
+
+        off = run_all(lambda: _ca(svc_off).test_client())
+        on = run_all(lambda: _ca(svc_on).test_client())
+        for q in QUERIES:
+            assert off[q]["generated_text"] == on[q]["generated_text"], q
+        # overlap really engaged: every lookahead-side request joined a
+        # future it launched at the HTTP layer (hit or late, never miss)
+        st = svc_on.lookahead.stats()
+        assert st["joins"] >= len(QUERIES)
+        assert st["overlap_rate"] > 0
+
+    def test_explicit_prelaunch_makes_join_nearly_free(self, smoke_pair):
+        _, _, svc_on, c_on = smoke_pair
+        q = QUERIES[0]
+        fut = svc_on.lookahead.launch(q)
+        assert fut is not None
+        _wait_for(fut.resolved, what="lookahead resolve")
+        body = c_on.post("/query", json={"prompt": q}).get_json()
+        assert body["timings"]["lookahead_hit"] == 1.0
+        # join-only retrieve: orders of magnitude under the solo stage cost
+        assert body["timings"]["embed_retrieve_ms"] < 50.0
+
+
+class TestSessionPipelining:
+    def test_speculation_prestages_next_turn_prefix(self, tmp_path):
+        svc, client = build_service(tmp_path, lookahead=True, prefix_cache=True)
+        try:
+            r = client.post("/upload_pdf",
+                            data={"file": (io.BytesIO(CORPUS), "d.pdf")},
+                            content_type="multipart/form-data")
+            assert r.status_code == 200
+            cache = svc.engine.prefix_cache
+            r1 = client.post("/query", json={
+                "prompt": "what links do TPUs use?", "session_id": "sess",
+            })
+            assert r1.status_code == 200
+            # turn N's speculation resolves + pre-stages during/after decode
+            _wait_for(
+                lambda: svc.lookahead.stats()["prestaged"] >= 1,
+                what="speculative prestage",
+            )
+            hits_before = cache.counters()["prefix_cache_hits"]
+            r2 = client.post("/query", json={
+                "prompt": "what about those links and collectives?",
+                "session_id": "sess",
+            })
+            assert r2.status_code == 200
+            # the single-chunk corpus makes turn 2 retrieve the same chunk
+            # set: its prefix resolve consumes the pre-staged chain
+            assert cache.counters()["prefix_cache_hits"] > hits_before
+            assert svc.lookahead._m_launched["session"].value >= 1
+        finally:
+            svc.shutdown()
+
+    def test_superseded_speculation_releases_unconsumed_staging(self, tmp_path):
+        svc, client = build_service(tmp_path, lookahead=True, prefix_cache=True)
+        try:
+            r = client.post("/upload_pdf",
+                            data={"file": (io.BytesIO(CORPUS), "d.pdf")},
+                            content_type="multipart/form-data")
+            assert r.status_code == 200
+            ex = svc.lookahead
+            # speculative future whose staging nothing ever consumes
+            f1 = ex.speculate("lonely", "a topic nobody asks about again")
+            assert f1 is not None
+            _wait_for(f1.resolved, what="speculation resolve")
+            _wait_for(lambda: ex.stats()["prestaged"] >= 1, what="prestage")
+            bytes_staged = svc.engine.prefix_cache.counters()["prefix_cache_bytes"]
+            assert bytes_staged > 0
+            f2 = ex.speculate("lonely", "an entirely different topic")
+            assert f2 is not None and f2 is not f1
+            _wait_for(
+                lambda: ex._m_wasted["superseded"].value >= 1,
+                what="supersede",
+            )
+            _wait_for(
+                lambda: ex._m_prestage_released.value >= 1,
+                what="stale release",
+            )
+        finally:
+            svc.shutdown()
+
+
+class _ImmediateSched:
+    """run_on_engine stub that executes the task inline — the dispatcher's
+    FIFO collapsed to synchronous, so the service wiring (prestage task →
+    release task generation threading) is testable without a live loop."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def run_on_engine(self, fn):
+        fn(self.engine)
+        return True
+
+
+class TestServicePoolWiring:
+    def test_release_handle_threads_registration_generation(self, tmp_path):
+        """The service handle carries the registration GENERATION from the
+        prestage task to the release task: a stale release (its staged
+        registration was evicted and re-created since) must keep the new
+        registration; the current owner's release must free it."""
+        svc, client = build_service(tmp_path, lookahead=True, prefix_cache=True)
+        try:
+            r = client.post("/upload_pdf",
+                            data={"file": (io.BytesIO(CORPUS), "d.pdf")},
+                            content_type="multipart/form-data")
+            assert r.status_code == 200
+            cont = ContinuousEngine(
+                svc.config.model, svc.engine.params,
+                sampling=svc.engine.sampling,
+                engine_config=dataclasses.replace(
+                    svc.engine.engine_config, kv_paged=True, kv_block_size=16
+                ),
+                dtypes=FP32,
+            )
+            svc.scheduler = _ImmediateSched(cont)
+            q = "what links do TPUs use?"
+            res = svc._retrieve(q)
+            h1 = svc._lookahead_prestage(q, res)
+            assert h1 is not None and isinstance(h1["pool"], int)
+            ck = h1["chain_key"]
+            assert cont.kv_pool.blocks_in_use() > 0
+            # pressure evicts the staged registration; live traffic
+            # re-creates one at the same chain key (fresh generation)
+            assert cont.release_prestaged(ck) is True
+            h2 = svc._lookahead_prestage(q, res)
+            assert h2 is not None and isinstance(h2["pool"], int)
+            assert h2["pool"] != h1["pool"]
+            # the STALE release must not free the re-created registration
+            svc._lookahead_release(h1)
+            assert cont.prestage_gen(ck) == h2["pool"]
+            # the current owner's release frees it
+            svc._lookahead_release(h2)
+            assert cont.prestage_gen(ck) is None
+            assert cont.kv_pool.blocks_in_use() == 0
+        finally:
+            svc.scheduler = None
+            svc.shutdown()
+
+
+class TestShedAbandon:
+    def test_queue_deadline_504_abandons_future(self, tmp_path):
+        """A request whose deadline expires WHILE QUEUED at the admission
+        gate (504, stage=queue) never claimed its future: the handler must
+        abandon it, or under sustained overload unclaimed futures pile up
+        to the inflight bound and silently disable lookahead."""
+        svc, client = build_service(tmp_path, lookahead=True)
+        try:
+            r = client.post("/upload_pdf",
+                            data={"file": (io.BytesIO(CORPUS), "d.pdf")},
+                            content_type="multipart/form-data")
+            assert r.status_code == 200
+            svc.admission.max_concurrency = 1
+            svc.admission.max_queue = 1
+            with svc.admission.admit():  # hold the only slot
+                r = client.post("/query", json={
+                    "prompt": "will expire in the queue", "deadline_ms": 60,
+                })
+            assert r.status_code == 504
+            assert r.get_json()["stage"] == "queue"
+            _wait_for(
+                lambda: svc.lookahead._m_wasted["abandoned"].value >= 1,
+                what="queue-expired future abandoned",
+            )
+        finally:
+            svc.shutdown()
+
+
+class TestFaultContainment:
+    def test_lookahead_fault_falls_back_inline(self, tmp_path):
+        """Armed ``lookahead_retrieve``: the join surfaces the fault, the
+        request retrieves inline and serves the SAME greedy answer."""
+        svc, client = build_service(tmp_path, lookahead=True)
+        try:
+            r = client.post("/upload_pdf",
+                            data={"file": (io.BytesIO(CORPUS), "d.pdf")},
+                            content_type="multipart/form-data")
+            assert r.status_code == 200
+            q = "what links do TPUs use?"
+            clean = client.post("/query", json={"prompt": q}).get_json()
+            faults.arm("lookahead_retrieve", 1)
+            faulted = client.post("/query", json={"prompt": q}).get_json()
+            assert faulted["generated_text"] == clean["generated_text"]
+            assert svc.lookahead._m_wasted["failed"].value >= 1
+        finally:
+            faults.clear()
+            svc.shutdown()
+
+
+class TestConfig:
+    def test_env_roundtrip(self):
+        cfg = AppConfig.from_env({
+            "TPU_RAG_LOOKAHEAD": "1",
+            "TPU_RAG_LOOKAHEAD_WORKERS": "3",
+            "TPU_RAG_LOOKAHEAD_INFLIGHT": "5",
+            "TPU_RAG_LOOKAHEAD_TTL_S": "7.5",
+            "TPU_RAG_LOOKAHEAD_PRESTAGE": "0",
+            "TPU_RAG_LOOKAHEAD_SESSIONS": "0",
+            "TPU_RAG_LOOKAHEAD_SESSION_TURNS": "4",
+            "TPU_RAG_LOOKAHEAD_SESSION_MAX": "32",
+            "TPU_RAG_LOOKAHEAD_SESSION_TTL_S": "120",
+        })
+        la = cfg.lookahead
+        assert la.enabled and la.max_workers == 3 and la.max_inflight == 5
+        assert la.ttl_s == 7.5
+        assert not la.prestage_kv and not la.session_pipelining
+        assert la.session_context_turns == 4
+        assert la.session_max == 32 and la.session_ttl_s == 120.0
+
+    def test_env_validation(self):
+        with pytest.raises(ValueError):
+            AppConfig.from_env({"TPU_RAG_LOOKAHEAD": "yes"})
+        with pytest.raises(ValueError):
+            AppConfig.from_env({"TPU_RAG_LOOKAHEAD_WORKERS": "0"})
+
+    def test_default_off(self):
+        assert not AppConfig().lookahead.enabled
+        # a service built from defaults has no executor
+        assert not AppConfig.from_env({}).lookahead.enabled

@@ -523,6 +523,48 @@ class TestQuantTP:
         ).generate(prompts)
         assert ref == got
 
+    def test_rope_headcut_sharding_is_exact(self):
+        """Root-cause pin for the two tp parity failures above (they predate
+        PR 6): tiny()'s K=2 kv heads do not tile tp=4, so the flat k/v
+        projection output — column-sharded over tp by the param specs —
+        reshapes to a SUB-head-sharded ``[B, S, K, hd]`` layout, and with
+        ``dp`` also populated this container's jax 0.4.x GSPMD miscompiles
+        the slice+concat rotate-by-halves RoPE over it: the jitted forward
+        returns wrong VALUES (~0.3 absolute on these logits) while eager is
+        exact. ``replicate_undividable_heads`` (models/llama.py) degrades
+        off-tile head projections to replicated before RoPE; this asserts
+        the jit-under-mesh logits match the single-device forward within
+        sharded-accumulation noise (measured ≤ 6e-3 at the default bf16
+        policy; the miscompile is ~50x that), so removing the guard fails
+        here on values — not just on downstream greedy tokens."""
+        cfg = tiny(False)
+        ctx = make_mesh(MeshConfig(dp=2, sp=1, tp=4))
+        assert cfg.num_kv_heads % ctx.tp != 0  # the miscompile's precondition
+        params = init_llama_params(jax.random.PRNGKey(0), cfg, DT)
+        B, S = 2, 8
+        tokens = jnp.asarray(
+            np.random.default_rng(7).integers(3, cfg.vocab_size, (B, S)),
+            jnp.int32,
+        )
+        pos = jnp.broadcast_to(jnp.arange(S), (B, S))
+        kv0 = jnp.zeros((B,), jnp.int32)
+        kvl = jnp.full((B,), S, jnp.int32)
+        cache = make_kv_cache(cfg, B, S, jnp.float32)
+        ref, _ = jax.jit(LlamaModel(cfg, DT).apply)(
+            {"params": params}, tokens, pos, cache, kv0, kvl, jnp.int32(0)
+        )
+        placed = shard_llama_params(params, ctx)
+        rep = ctx.replicated
+        model_tp = LlamaModel(cfg, DT, mesh=ctx.mesh)
+        got, _ = jax.jit(model_tp.apply)(
+            {"params": placed},
+            *(jax.device_put(a, rep) for a in (tokens, pos)),
+            jax.device_put(cache, rep), *(
+                jax.device_put(a, rep) for a in (kv0, kvl)
+            ), jnp.int32(0),
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=0.03)
+
     def test_streaming_put_preserves_quant_dtypes(self):
         cfg = tiny(True)
         ctx = make_mesh(MeshConfig(dp=2, sp=1, tp=4))

@@ -17,6 +17,8 @@ from rag_llm_k8s_tpu.core.config import (
     EncoderConfig,
     EngineConfig,
     LlamaConfig,
+    LookaheadConfig,
+    PrefixCacheConfig,
     ResilienceConfig,
     SamplingConfig,
 )
@@ -490,12 +492,14 @@ class ByteTokenizer:
         return bytes((i - 3) % 256 for i in ids if i >= 3).decode("utf-8", "replace")
 
 
-def make_service(resilience=None, prompt_buckets=(128, 256), max_seq_len=4096 + 256):
+def make_service(resilience=None, prompt_buckets=(128, 256), max_seq_len=4096 + 256,
+                 lookahead=None):
     llama_cfg = LlamaConfig.tiny(vocab_size=300)
     enc_cfg = EncoderConfig.tiny(vocab_size=300)
     cfg = AppConfig(
         model=llama_cfg, encoder=enc_cfg,
         resilience=resilience or ResilienceConfig(),
+        lookahead=lookahead or LookaheadConfig(),
     )
     engine = InferenceEngine(
         llama_cfg,
@@ -680,3 +684,77 @@ class TestDegradedMarking:
             assert snap["rag_degraded_responses_total"] == 1
         finally:
             svc.engine.prefix_cache = None
+
+
+# ---------------------------------------------------------------------------
+# lookahead chaos (ISSUE 7): the lookahead_retrieve fault site + stale-
+# prefetch cancellation, under the same armed-harness lane as the rest of
+# this file (tests/test_lookahead.py carries the full pipeline matrix)
+# ---------------------------------------------------------------------------
+class TestLookaheadChaos:
+    def test_lookahead_fault_falls_back_and_serves(self):
+        """Armed ``lookahead_retrieve``: the speculation's worker faults,
+        the serving tail's join surfaces it, the request falls back to the
+        INLINE retrieve path and serves the identical greedy answer — a
+        failed speculation must never fail (or change) a request."""
+        svc = make_service(lookahead=LookaheadConfig(enabled=True))
+        try:
+            client = create_app(svc).test_client()
+            clean = client.post("/query", json={"prompt": "alpha"}).get_json()
+            faults.arm("lookahead_retrieve", times=1)
+            faulted = client.post("/query", json={"prompt": "alpha"}).get_json()
+            assert faults.armed() == {}, "lookahead_retrieve never fired"
+            assert faulted["generated_text"] == clean["generated_text"]
+            assert svc.lookahead._m_wasted["failed"].value >= 1
+            # harness healthy afterwards: the next lookahead join serves
+            after = client.post("/query", json={"prompt": "alpha"}).get_json()
+            assert after["generated_text"] == clean["generated_text"]
+        finally:
+            svc.shutdown()
+
+    def test_superseded_prestage_returns_every_block(self, tiny):
+        """Stale-prefetch cancellation, both substrates: a speculation that
+        loses before admission releases every prefix-cache byte AND every
+        registered pool block it warmed — zero leaks, idempotent."""
+        cfg, params, _ = tiny
+        pc = PrefixCacheConfig(
+            enabled=True, max_prefix_tokens=48, segment_buckets=(16,),
+            suffix_buckets=(16,), hbm_budget_mb=64,
+        )
+        ie = InferenceEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=EngineConfig(
+                prompt_buckets=(64,), max_batch_size=2, max_seq_len=128,
+                prefix_cache=pc,
+            ),
+            dtypes=FP32,
+        )
+        import dataclasses
+
+        cont = ContinuousEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=dataclasses.replace(
+                ie.engine_config, kv_paged=True, kv_block_size=16
+            ),
+            dtypes=FP32,
+        )
+        cache = ie.prefix_cache
+        bytes0 = cache.counters()["prefix_cache_bytes"]
+        blocks0 = cont.kv_pool.blocks_in_use()
+        segments = [
+            ("head:chaos", [cfg.bos_token_id] + [7] * 15),
+            ("chunk:chaos", [9] * 16),
+        ]
+        cp, record = cache.stage(segments)
+        assert cp is not None and cp.chain_key is not None
+        assert cont.prestage_prefix(cp) == "registered"
+        assert cont.kv_pool.blocks_in_use() > blocks0
+        # the speculation loses: release must return BOTH substrates to
+        # their pre-staging footprint, and double-release must be a no-op
+        # (only_unused is honest here — no admission mapped the chain)
+        assert cache.release_staged(record) > 0
+        assert cont.release_prestaged(cp.chain_key, only_unused=True) is True
+        assert cache.counters()["prefix_cache_bytes"] == bytes0
+        assert cont.kv_pool.blocks_in_use() == blocks0
+        assert cache.release_staged(record) == 0
+        assert cont.release_prestaged(cp.chain_key) is False
