@@ -907,6 +907,141 @@ def measure_lookahead_overlap() -> dict:
         svc_off.shutdown()
 
 
+def measure_kv_tiering() -> dict:
+    """Hotness-aware KV tiering (ISSUE 8 acceptance leg): effective
+    cached-chunk capacity at a FIXED HBM budget, and the swap-in hide rate
+    under the lookahead prestage path.
+
+    Two identical prefix caches (real tiny engine, real KV plane bytes)
+    ingest the same 128-chunk stream against a 1 MiB HBM budget:
+
+    - **hot-only** (tiering off): the LRU evicts past the budget — an
+      evicted chunk costs a full re-prefill on its next use; residency is
+      whatever the budget holds in native dtype.
+    - **tiered**: a fake clock decays hotness one step per insert and a
+      retier sweep runs between inserts — recent chunks stay hot bf16,
+      the next band quantizes warm int8 in place, the rest spill to host
+      RAM. A chunk in ANY tier serves without re-prefill (warm =
+      dequantized splice, cold = one swap-in), so servable capacity is
+      everything the three tiers hold at the same device-byte budget.
+
+    Acceptance: ``effective_capacity_x`` ≥ 3. The hide-rate pass then
+    demotes chains cold and swaps them back through ``stage()`` (the
+    lookahead prestage trigger — overlapped with decode in serving) vs
+    one deliberate demand resolve, reporting hidden/(hidden+demand)."""
+    import jax
+
+    from rag_llm_k8s_tpu.core.config import (
+        DTypePolicy,
+        EngineConfig,
+        KVTieringConfig,
+        LlamaConfig,
+        PrefixCacheConfig,
+        SamplingConfig,
+    )
+    from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+    from rag_llm_k8s_tpu.engine.prefix_cache import PrefixCache
+    from rag_llm_k8s_tpu.engine.tiering import HotnessTracker
+    from rag_llm_k8s_tpu.models.llama import init_llama_params
+
+    import numpy as np
+
+    fp32 = DTypePolicy.fp32()
+    cfg = LlamaConfig.tiny(vocab_size=128)
+    pc = PrefixCacheConfig(
+        enabled=True, max_prefix_tokens=64, segment_buckets=(64,),
+        suffix_buckets=(16,), hbm_budget_mb=1,
+    )
+    engine = InferenceEngine(
+        cfg,
+        init_llama_params(jax.random.PRNGKey(0), cfg, fp32),
+        sampling=SamplingConfig(do_sample=False, max_new_tokens=4),
+        engine_config=EngineConfig(
+            prompt_buckets=(64,), max_batch_size=2, speculative="off",
+            max_seq_len=128, prefix_cache=pc,
+        ),
+        dtypes=fp32,
+    )
+    rng = np.random.default_rng(0)
+    N_CHUNKS = 128  # > 3x the budget's hot-only residency (32 chunks)
+    chains = [
+        [(f"chunk:{i}", list(map(int, rng.integers(3, 120, 64))))]
+        for i in range(N_CHUNKS)
+    ]
+    tiering = KVTieringConfig(
+        enabled=True, warm_below=0.3, cold_below=0.05, half_life_s=2.0,
+        retier_interval_s=3600.0, host_spill_mb=64,
+    )
+
+    # hot-only: the budget's native-dtype residency
+    hot_cache = PrefixCache(pc, engine)
+    for segs in chains:
+        hot_cache.prefix_for(segs)
+    hot_resident = len(hot_cache._entries)
+    hot_bytes = hot_cache.entry_bytes
+    hot_cache.clear()
+
+    # tiered: one decay step per insert, retier between inserts
+    clock = {"now": 0.0}
+    tiered = PrefixCache(pc, engine, tiering=tiering)
+    tiered.hotness = HotnessTracker(
+        tiering.half_life_s, clock=lambda: clock["now"]
+    )
+    for segs in chains:
+        tiered.prefix_for(segs)
+        clock["now"] += 1.0
+        tiered.retier(force=True)
+    servable = sum(
+        1 for k, e in tiered._entries.items()
+        if e.tier != "cold" or k in tiered.spill
+    )
+    capacity_x = servable / max(hot_resident, 1)
+
+    # swap-in hide rate: prestage (lookahead trigger) vs one demand resolve
+    swap_chains = chains[:8]
+    for segs in swap_chains:
+        tiered.stage(segs, trigger="lookahead")  # the prestage path
+        clock["now"] += 1.0
+        tiered.retier(force=True)
+    demand_chain = chains[len(chains) // 2]
+    tiered.force_demote("cold", seg_key=demand_chain[0][0])
+    tiered._assembled.clear()
+    tiered.assembled_bytes = 0
+    t0 = time.monotonic()
+    tiered.prefix_for(demand_chain)  # the critical-path swap-in
+    swap_ms = (time.monotonic() - t0) * 1e3
+    st = tiered.tier_stats()
+    hidden = st["swap_ins_lookahead"]
+    demand = st["swap_ins_demand"]
+    t0 = time.monotonic()
+    tiered.prefix_for(
+        [("chunk:fresh", list(map(int, rng.integers(3, 120, 64))))]
+    )  # a cold MISS for scale: what a swap-in avoids
+    rebuild_ms = (time.monotonic() - t0) * 1e3
+    return {
+        "kv_tiering": {
+            "hbm_budget_mb": pc.hbm_budget_mb,
+            "chunk_stream": N_CHUNKS,
+            "hot_only_resident_chunks": hot_resident,
+            "hot_only_resident_bytes": hot_bytes,
+            "tiered_servable_chunks": servable,
+            "tiered_device_bytes": int(tiered.entry_bytes),
+            "tiered_host_bytes": int(st["tier_cold_host_bytes"]),
+            # the acceptance headline: servable cached chunks per unit of
+            # the SAME device budget, tiered vs hot-only (≥ 3 accepted)
+            "effective_capacity_x": round(capacity_x, 2),
+            "swap_ins_hidden": hidden,
+            "swap_ins_demand": demand,
+            "swap_in_hide_rate": round(
+                hidden / max(hidden + demand, 1), 3
+            ),
+            "swap_in_fallbacks": st["swap_in_fallbacks"],
+            "demand_swap_in_ms": round(swap_ms, 2),
+            "recompute_ms": round(rebuild_ms, 2),
+        }
+    }
+
+
 def measure_ingest_scale() -> dict:
     """VERDICT r4 #6: corpus-scale ingest THROUGH the HTTP path, snapshot
     save/load timing at that size, and live-index /query probes.
@@ -2270,6 +2405,7 @@ def bench_legs(line: dict):
         ("paged_kv", lambda: line.update(measure_paged())),
         ("paged_tp", lambda: line.update(measure_paged_tp())),
         ("lookahead_overlap", lambda: line.update(measure_lookahead_overlap())),
+        ("kv_tiering", lambda: line.update(measure_kv_tiering())),
         ("query_e2e", lambda: line.update(measure_query_e2e())),
         ("ingest_scale", lambda: line.update(measure_ingest_scale())),
     ]

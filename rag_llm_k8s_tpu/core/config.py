@@ -314,6 +314,69 @@ class PrefixCacheConfig:
 
 
 @dataclass(frozen=True)
+class KVTieringConfig:
+    """Hotness-aware KV tiering (engine/tiering.py + engine/prefix_cache.py
+    — HA-RAG, PAPERS.md).
+
+    Every cached chunk carries a decayed hit-frequency score (fed by
+    prefix-cache resolve hits, lookahead joins, and pool prestage
+    registrations). Tier policy over that one signal:
+
+    - **hot** (score ≥ ``warm_below``): KV stays in the engine's native
+      dtype in HBM — exactly the untiered behavior, byte-identical streams;
+    - **warm** (``cold_below`` ≤ score < ``warm_below``): KV quantizes IN
+      PLACE to int8 (+ per-(token, kv-head) fp32 scales — the ``_q8``
+      kernel layout) with no re-prefill: the chunk's HBM bytes roughly
+      halve and decoded streams stay within the pinned int8 logit
+      tolerance;
+    - **cold** (score < ``cold_below``): KV spills to host RAM (zero HBM)
+      and swaps back in asynchronously ahead of admission — the lookahead
+      pipeline's prestage is the prefetch trigger, so a swap-in overlaps
+      the previous request's decode instead of stalling prefill.
+
+    Off by default: tier transitions trade bounded quality drift (warm)
+    and swap-in latency (cold) for effective cache capacity — deployments
+    opt in. All knobs: env ``TPU_RAG_KV_TIERING*``.
+    """
+
+    # master switch (env TPU_RAG_KV_TIERING)
+    enabled: bool = False
+    # decayed-score demotion thresholds (env TPU_RAG_KV_TIERING_WARM_BELOW
+    # / TPU_RAG_KV_TIERING_COLD_BELOW; cold_below must not exceed
+    # warm_below). A score decays by half every half_life_s, so with the
+    # defaults a chunk untouched for ~2 half-lives goes warm and one
+    # untouched for ~4 goes cold.
+    warm_below: float = 0.25
+    cold_below: float = 0.0625
+    # hit-frequency decay half-life, seconds (env
+    # TPU_RAG_KV_TIERING_HALF_LIFE_S)
+    half_life_s: float = 60.0
+    # host-RAM budget for cold-spilled chunk KV, MiB (env
+    # TPU_RAG_KV_TIERING_HOST_MB). Spills past it evict oldest-first —
+    # a chunk falling off the host store recomputes on its next miss.
+    host_spill_mb: int = 1024
+    # minimum seconds between opportunistic retier sweeps on the resolve
+    # path (env TPU_RAG_KV_TIERING_INTERVAL_S); retier(force=True) ignores
+    # it (tests, maintenance)
+    retier_interval_s: float = 5.0
+
+    def validate(self) -> None:
+        if self.cold_below > self.warm_below:
+            raise ValueError(
+                f"kv tiering: cold_below={self.cold_below} must not exceed "
+                f"warm_below={self.warm_below}"
+            )
+        if self.half_life_s <= 0:
+            raise ValueError(
+                f"kv tiering: half_life_s={self.half_life_s}: expected > 0"
+            )
+        if self.host_spill_mb < 1:
+            raise ValueError(
+                f"kv tiering: host_spill_mb={self.host_spill_mb}: expected >= 1"
+            )
+
+
+@dataclass(frozen=True)
 class EngineConfig:
     """Serving-engine shape limits (no reference equivalent — the reference
     re-runs full HF generate per request, single-threaded)."""
@@ -462,6 +525,9 @@ class EngineConfig:
     kv_pool_blocks: int = 0
     # cross-request KV prefix cache (see PrefixCacheConfig)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
+    # hotness-aware KV tiering over the cached chunks (see KVTieringConfig;
+    # needs prefix_cache.enabled to have anything to tier)
+    kv_tiering: KVTieringConfig = field(default_factory=KVTieringConfig)
 
     def validate_tp_layout(self, tp: int, num_kv_heads: int) -> None:
         """Paged KV on a ``tp > 1`` mesh serves from a HEAD-sharded arena:
@@ -742,6 +808,37 @@ class AppConfig:
                     engine.prefix_cache, hbm_budget_mb=mb
                 ),
             )
+        tiering = engine.kv_tiering
+        if "TPU_RAG_KV_TIERING" in env:
+            flag = env["TPU_RAG_KV_TIERING"]
+            if flag not in ("0", "1"):
+                raise ValueError(
+                    f"TPU_RAG_KV_TIERING={flag!r}: expected '0' or '1'"
+                )
+            tiering = dataclasses.replace(tiering, enabled=flag == "1")
+        if "TPU_RAG_KV_TIERING_WARM_BELOW" in env:
+            tiering = dataclasses.replace(
+                tiering, warm_below=float(env["TPU_RAG_KV_TIERING_WARM_BELOW"])
+            )
+        if "TPU_RAG_KV_TIERING_COLD_BELOW" in env:
+            tiering = dataclasses.replace(
+                tiering, cold_below=float(env["TPU_RAG_KV_TIERING_COLD_BELOW"])
+            )
+        if "TPU_RAG_KV_TIERING_HALF_LIFE_S" in env:
+            tiering = dataclasses.replace(
+                tiering, half_life_s=float(env["TPU_RAG_KV_TIERING_HALF_LIFE_S"])
+            )
+        if "TPU_RAG_KV_TIERING_HOST_MB" in env:
+            tiering = dataclasses.replace(
+                tiering, host_spill_mb=int(env["TPU_RAG_KV_TIERING_HOST_MB"])
+            )
+        if "TPU_RAG_KV_TIERING_INTERVAL_S" in env:
+            tiering = dataclasses.replace(
+                tiering,
+                retier_interval_s=float(env["TPU_RAG_KV_TIERING_INTERVAL_S"]),
+            )
+        tiering.validate()  # cross-field rules once, with the env applied
+        engine = dataclasses.replace(engine, kv_tiering=tiering)
         resilience = cfg.resilience
 
         def _res_int(var: str, field_name: str, minimum: int):

@@ -186,6 +186,16 @@ class ContinuousEngine:
             # was (re-)registered — release_prestaged(only_unused=True)
             # keeps a registration live traffic has proven hot
             self._prefix_uses: Dict[object, int] = {}
+            # hotness tier per registration (engine/tiering.py): admission
+            # and growth pressure reclaim non-hot registrations FIRST (and
+            # even while rows decode — a warm chunk's KV survives in the
+            # prefix cache, one re-scatter away), so tier occupancy, not
+            # raw headroom, decides backpressure
+            self._prefix_tier: Dict[object, str] = {}
+            # non-hot registered blocks right now — a single int the
+            # admission gate's reclaimable hint reads LOCK-FREE from the
+            # HTTP threads (maintained only on the scheduler thread)
+            self._reclaimable_blocks = 0
             # registration GENERATION per chain key: a deferred lookahead
             # release presents the generation it staged, so it can never
             # free a registration a later admission re-created at the same
@@ -414,6 +424,8 @@ class ContinuousEngine:
             self._prefix_blocks.clear()
             self._prefix_uses.clear()
             self._prefix_reg_gen.clear()
+            self._prefix_tier.clear()
+            self._reclaimable_blocks = 0
             self._registered_tokens = 0
             # pending preemption records describe PRE-reset slots; the reset
             # recovery resubmits every in-flight request itself, so replaying
@@ -808,7 +820,7 @@ class ContinuousEngine:
         self.stats.decode_tokens += 1
         return row, None
 
-    def prestage_prefix(self, prefix) -> "str | bool":
+    def prestage_prefix(self, prefix, tier: str = "hot") -> "str | bool":
         """Warm a ``CachedPrefix``'s full blocks into POOL blocks ahead of
         any admission (the lookahead pipeline's paged leg — rag/lookahead):
         allocate ``length // block_size`` blocks, scatter the prefix planes
@@ -827,6 +839,14 @@ class ContinuousEngine:
         False when nothing was staged."""
         if not self.paged:
             return False
+        if tier == "cold":
+            # a cold REGISTRATION must not exist (cold = not in the pool;
+            # set_prefix_tier drops on cold for the same reason) — and the
+            # prestage itself is evidence the chain is about to be used,
+            # so register it reclaimable-but-resident
+            tier = "warm"
+        if tier not in ("hot", "warm"):
+            raise ValueError(f"prestage tier={tier!r}: expected hot|warm|cold")
         key = getattr(prefix, "chain_key", None)
         if key is None:  # "slot"-mode prefixes are not content-identical
             return False
@@ -845,6 +865,17 @@ class ContinuousEngine:
         if not self.kv_pool.can_alloc(full_n + self.MB):
             return False  # headroom: live traffic keeps a full row's growth
         ids = self.kv_pool.alloc(full_n)
+        try:
+            # fault site "kv_swap_in": a cold chain's host→HBM re-stage
+            # dying between alloc and scatter. Nothing was scattered and
+            # nothing donated — free the blocks and decline, and the
+            # admission path recomputes from tokens (zero leaked blocks;
+            # distinct from a real scatter failure below, which invalidates
+            # the donated arena and must reset)
+            faults.maybe_fail("kv_swap_in")
+        except faults.InjectedFault:
+            self.kv_pool.free(ids)
+            return False
         nbp = P // bs
         scatter_ids = np.zeros((nbp,), np.int32)
         scatter_ids[:full_n] = ids
@@ -860,8 +891,9 @@ class ContinuousEngine:
             ) from e
         # alloc()'s ref IS the registration ref (no row holds these yet) —
         # every reclaim path goes through _drop_registration, so
-        # registrations free exactly once
-        self._register_prefix(key, ids, plen)
+        # registrations free exactly once. ``tier`` (from the prefix
+        # cache's hotness) decides how readily admission reclaims it.
+        self._register_prefix(key, ids, plen, tier=tier)
         return "registered"
 
     def prestage_gen(self, chain_key):
@@ -872,15 +904,21 @@ class ContinuousEngine:
         Same thread contract as ``prestage_prefix``."""
         return self._prefix_reg_gen.get(chain_key)
 
-    def _register_prefix(self, key, ids, plen: int) -> int:
+    def _register_prefix(self, key, ids, plen: int, tier: str = "hot") -> int:
         """Register a chain's full blocks for future copy-free sharing and
         return the registration generation; enforces the bounded-8 set.
-        The caller has already taken the registration's pool ref."""
+        The caller has already taken the registration's pool ref. ``tier``
+        is the chain's hotness class — non-hot registrations are the first
+        blocks admission reclaims under pressure."""
         self._reg_seq += 1
         cov = len(ids) * self.block_size
         self._prefix_blocks[key] = (list(ids), cov, plen)
         self._prefix_uses[key] = 0
         self._prefix_reg_gen[key] = self._reg_seq
+        self._prefix_tier[key] = tier
+        self.kv_pool.account_tier(tier, len(ids))
+        if tier != "hot":
+            self._reclaimable_blocks += len(ids)
         self._registered_tokens += cov
         while len(self._prefix_blocks) > 8:  # bounded registration set
             self._drop_registration(next(iter(self._prefix_blocks)))
@@ -895,9 +933,75 @@ class ContinuousEngine:
         self._prefix_uses.pop(key, None)
         self._prefix_reg_gen.pop(key, None)
         ids, cov, _ = entry
+        tier = self._prefix_tier.pop(key, "hot")
+        self.kv_pool.account_tier(tier, -len(ids))
+        if tier != "hot":
+            self._reclaimable_blocks = max(
+                0, self._reclaimable_blocks - len(ids)
+            )
         self._registered_tokens -= cov
         self.kv_pool.free(ids)
         return True
+
+    def set_prefix_tier(self, chain_key, tier: str) -> bool:
+        """Move a registration between hotness tiers (scheduler thread —
+        the service's retier maintenance arrives via ``run_on_engine``).
+        ``"cold"`` DROPS the registration: a cold chain's arena blocks go
+        back to the pool and its KV survives only in the prefix cache's
+        host spill, one prestage re-scatter away (the pool-side spill).
+        Returns True when anything changed."""
+        if not self.paged:
+            return False
+        entry = self._prefix_blocks.get(chain_key)
+        if entry is None:
+            return False
+        if tier == "cold":
+            return self._drop_registration(chain_key)
+        old = self._prefix_tier.get(chain_key, "hot")
+        if old == tier:
+            return False
+        n = len(entry[0])
+        self.kv_pool.account_tier(old, -n)
+        self.kv_pool.account_tier(tier, n)
+        self._prefix_tier[chain_key] = tier
+        if old == "hot" and tier != "hot":
+            self._reclaimable_blocks += n
+        elif old != "hot" and tier == "hot":
+            self._reclaimable_blocks = max(0, self._reclaimable_blocks - n)
+        return True
+
+    def retier_registrations(self, tier_fn) -> int:
+        """Re-tag every registered chain with ``tier_fn(chain_key)`` — the
+        cache→pool tier mirror (the service passes the prefix cache's
+        ``chain_tier``; scheduler thread via ``run_on_engine``). A chain
+        judged "cold" drops its registration. Returns how many
+        registrations changed. Keeps the registration table behind the
+        engine's API — callers never touch ``_prefix_blocks``."""
+        if not self.paged:
+            return 0
+        changed = 0
+        for key in list(self._prefix_blocks):
+            if self.set_prefix_tier(key, tier_fn(key)):
+                changed += 1
+        return changed
+
+    def tier_occupancy(self) -> Dict[str, int]:
+        """Registered-block tier ledger + live-row blocks (the pool's
+        view; empty dict dense). Reading the POOL's lock-guarded ledger is
+        scrape-safe from any thread."""
+        if not self.paged:
+            return {}
+        return self.kv_pool.tier_occupancy()
+
+    def reclaimable_blocks(self) -> int:
+        """Non-hot registered blocks the scheduler can reclaim without
+        touching a live row — the admission gate's tier-occupancy signal
+        (lock-free read of a scheduler-maintained int): while this is
+        positive, a saturated pool is NOT a shed — the next admission
+        sweep frees these and the request only queues."""
+        if not self.paged:
+            return 0
+        return self._reclaimable_blocks
 
     def release_prestaged(self, chain_key, only_unused: bool = False,
                           gen=None) -> bool:
@@ -1420,6 +1524,20 @@ class ContinuousEngine:
         want = min(need + 1, self.MB)
         if self.kv_pool.can_alloc(want):
             return "ok"
+        if self._prefix_blocks:
+            # tier occupancy, not raw headroom: WARM registrations give
+            # their blocks to a live admission even while rows decode —
+            # the chunk KV survives (int8) in the prefix cache, one
+            # re-scatter away, so reclaiming them costs a future re-stage,
+            # never a re-prefill. HOT registrations are proven-shared
+            # working set and are only sacrificed when nothing decodes
+            # (the idle branch below).
+            for key in [
+                k for k, t in list(self._prefix_tier.items()) if t != "hot"
+            ]:
+                self._drop_registration(key)
+                if self.kv_pool.can_alloc(want):
+                    return "ok"
         if self._prefix_blocks and not self.has_active():
             # nothing is decoding, yet the pool can't take one prompt: the
             # registered prefix blocks are the only other holder — drop the
@@ -1473,9 +1591,18 @@ class ContinuousEngine:
             # growth blocked: drop registered prefix blocks first (cache
             # refs are re-buildable; without this a lone active row whose
             # growth the registrations crowd out would preempt ITSELF in a
-            # loop), then preempt the newest active row and retry
+            # loop), then preempt the newest active row and retry.
+            # Non-hot registrations go first — a warm chunk costs one
+            # re-scatter to bring back, a hot one a proven-shared re-stage
             if self._prefix_blocks:
-                self._drop_registration(next(iter(self._prefix_blocks)))
+                victim = min(
+                    self._prefix_blocks,
+                    key=lambda k: (
+                        self._prefix_tier.get(k, "hot") == "hot",
+                        self._prefix_reg_gen.get(k, 0),
+                    ),
+                )
+                self._drop_registration(victim)
                 continue
             victims = [
                 (s.admit_seq, r) for r, s in enumerate(self.slots) if s.active

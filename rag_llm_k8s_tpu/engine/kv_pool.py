@@ -92,6 +92,13 @@ class KVBlockPool:
         # cumulative counters (engine stats / bench)
         self.total_allocs = 0
         self.total_exhaustions = 0
+        # per-tier occupancy of REGISTERED prefix blocks (hotness tiering,
+        # engine/tiering.py): the engine accounts each registration's
+        # blocks under its chunk's tier at register/drop/retier time, so
+        # admission can tell "the pool is full of hot rows" (true pressure)
+        # from "the pool is full of demotable cache warmth" (reclaimable).
+        # Pure bookkeeping — the allocator itself is tier-oblivious.
+        self._tier_blocks: Dict[str, int] = {"hot": 0, "warm": 0, "cold": 0}
 
     # -- capacity -------------------------------------------------------
     def blocks_for(self, tokens: int) -> int:
@@ -179,6 +186,28 @@ class KVBlockPool:
         with self._lock:
             return self._refs.get(block, 0)
 
+    # -- tier accounting (hotness-aware KV tiering) ---------------------
+    def account_tier(self, tier: str, delta: int) -> None:
+        """Move ``delta`` registered-prefix blocks into ``tier``'s ledger
+        (negative = out). The engine calls this at registration, drop, and
+        retier sites; clamped at zero so a double-drop can't go negative."""
+        if tier not in self._tier_blocks:
+            raise ValueError(
+                f"unknown kv tier {tier!r}; tiers: {tuple(self._tier_blocks)}"
+            )
+        with self._lock:
+            self._tier_blocks[tier] = max(0, self._tier_blocks[tier] + delta)
+
+    def tier_occupancy(self) -> Dict[str, int]:
+        """Registered-prefix blocks per tier + the non-registration rest
+        (``rows`` — blocks owned by live decode rows, derived)."""
+        with self._lock:
+            out = dict(self._tier_blocks)
+            registered = sum(out.values())
+            in_use = (self.num_blocks - 1) - len(self._free)
+            out["rows"] = max(0, in_use - registered)
+            return out
+
     def reset(self) -> None:
         """Return EVERY block to the free list (engine reset: the arena is
         rebuilt and every table with it — holding stale refs would leak the
@@ -187,6 +216,10 @@ class KVBlockPool:
         with self._lock:
             self._refs.clear()
             self._free = deque(range(1, self.num_blocks))
+            # registrations died with the arena: their tier ledgers must
+            # read zero or admission would see phantom reclaimable warmth
+            for t in self._tier_blocks:
+                self._tier_blocks[t] = 0
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -197,6 +230,8 @@ class KVBlockPool:
                 "kv_pool_blocks_free": len(self._free),
                 "kv_pool_allocs_total": self.total_allocs,
                 "kv_pool_exhaustions_total": self.total_exhaustions,
+                "kv_pool_tier_hot_blocks": self._tier_blocks["hot"],
+                "kv_pool_tier_warm_blocks": self._tier_blocks["warm"],
             }
 
     def __repr__(self) -> str:  # debugging / log lines

@@ -40,9 +40,20 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rag_llm_k8s_tpu.engine.tiering import (
+    HostSpillStore,
+    HotnessTracker,
+    dequantize_planes,
+    quantize_planes,
+)
+from rag_llm_k8s_tpu.resilience import faults
 
 logger = logging.getLogger(__name__)
 
@@ -75,9 +86,13 @@ class CachedPrefix:
 
 @dataclass
 class _Entry:
-    planes: Tuple  # [L, 1, K, Sb, hd] (+ scale planes) device arrays
+    # device planes: the engine's NATIVE layout when tier is hot
+    # ((k, v) — or (k, v, k_scale, v_scale) under int8-KV), the int8
+    # quantized 4-tuple when warm on a bf16 engine, and None when cold
+    # (the payload lives in the host spill store)
+    planes: Optional[Tuple]
     seg_len: int  # real tokens (<= bucket)
-    nbytes: int
+    nbytes: int  # DEVICE bytes currently held (0 while cold)
     pinned: bool = False
     # consumptions since creation (every resolve that HITS this entry bumps
     # it) — lookahead staging records the creation-time value so a stale
@@ -86,8 +101,16 @@ class _Entry:
     # creation stamp (monotonic per cache, set by _insert): staging records
     # it so a stale release never drops a DIFFERENT entry rebuilt at the
     # same key after the staged one was budget-evicted (a fresh rebuild
-    # also starts at uses=0 — the use counter alone can't tell them apart)
+    # also starts at uses=0 — the use counter alone can't tell them apart).
+    # Tier transitions mutate the entry IN PLACE and never touch the stamp:
+    # a demote-while-prestaged keeps PR 7's creation-stamp discipline.
     stamp: int = 0
+    # hotness tier (engine/tiering.py): "hot" | "warm" | "cold"
+    tier: str = "hot"
+    # planes went through the int8 round trip (warm demotion on a non-int8
+    # engine): splices must dequantize first, and the bounded int8 drift
+    # applies to everything served from this entry until it is rebuilt
+    quantized: bool = False
 
 
 def _planes_nbytes(planes: Tuple) -> int:
@@ -102,13 +125,47 @@ class PrefixCache:
     a partially written block.
     """
 
-    def __init__(self, config, engine):
+    def __init__(self, config, engine, tiering=None):
         if config.reuse not in ("exact", "slot"):
             raise ValueError(
                 f"prefix_cache.reuse={config.reuse!r}: expected 'exact' or 'slot'"
             )
         self.config = config
         self.engine = engine  # owning InferenceEngine (builds the blocks)
+        # hotness-aware tiering (engine/tiering.py, HA-RAG): taken from the
+        # explicit arg (tests) or the owning engine's config; None = every
+        # entry stays hot forever — the exact pre-tiering behavior
+        if tiering is None:
+            tiering = getattr(
+                getattr(engine, "engine_config", None), "kv_tiering", None
+            )
+        enabled = tiering is not None and getattr(tiering, "enabled", False)
+        self.tiering = tiering if enabled else None
+        if self.tiering is not None:
+            self.tiering.validate()
+            self.hotness = HotnessTracker(self.tiering.half_life_s)
+            self.spill = HostSpillStore(self.tiering.host_spill_mb)
+        else:
+            self.hotness = None
+            self.spill = None
+        # anchored at construction: the first opportunistic sweep waits a
+        # full interval (a cache with nothing demotable yet should not pay
+        # a sweep on its very first resolve)
+        self._last_retier = time.monotonic()
+        # set by the service: called (outside the lock) after a retier
+        # sweep that moved anything, so pool-side registration tiers can
+        # follow the cache's hotness (ContinuousEngine.set_prefix_tier via
+        # run_on_engine)
+        self.on_retier = None
+        # tier-transition counters (read by tier_stats / rag_kv_tier_*)
+        self._tier_counts: Dict[str, int] = {
+            "swap_ins_lookahead": 0,
+            "swap_ins_demand": 0,
+            "swap_in_fallbacks": 0,
+            "demotes_warm": 0,
+            "demotes_cold": 0,
+            "promotes": 0,
+        }
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self._assembled: "OrderedDict[tuple, Tuple[Tuple, int]]" = OrderedDict()
@@ -164,7 +221,9 @@ class PrefixCache:
         0. Reads only host-side handles — no device sync."""
         out: Dict[int, int] = {}
 
-        def _attribute(planes: Tuple) -> None:
+        def _attribute(planes: Optional[Tuple]) -> None:
+            if planes is None:
+                return  # cold-tier entry: its bytes live in host RAM
             for p in planes:
                 nbytes = int(getattr(p, "nbytes", 0))
                 try:
@@ -190,7 +249,8 @@ class PrefixCache:
 
     # -- the one public resolve/populate entry point ---------------------
     def prefix_for(self, segments: Sequence[Tuple[str, Sequence[int]]],
-                   _staged: Optional[Dict] = None) -> Optional[CachedPrefix]:
+                   _staged: Optional[Dict] = None,
+                   _trigger: str = "demand") -> Optional[CachedPrefix]:
         """Resolve an ordered segment list ``[(key, token_ids), ...]`` into a
         spliced prefix buffer, building (and caching) any missing blocks —
         the miss path IS the populate path, so prefill work is never done
@@ -200,7 +260,11 @@ class PrefixCache:
 
         ``_staged`` (``stage()``'s bookkeeping dict) collects which entry
         keys / assembled buffer this call CREATED, so a stale speculation
-        can release exactly them later.
+        can release exactly them later. ``_trigger`` attributes any
+        cold-tier swap-ins this resolve performs: ``"lookahead"`` when the
+        resolve rides the lookahead prestage (the swap-in overlapped the
+        previous request's decode), ``"demand"`` when it sits on a serving
+        tail's critical path.
         """
         total = sum(len(ids) for _, ids in segments)
         P = self.config.max_prefix_tokens
@@ -227,6 +291,10 @@ class PrefixCache:
                     if e is not None:
                         self._entries.move_to_end(ek)
                         e.uses += 1
+                    if self.hotness is not None:
+                        # a memo hit is the hottest possible signal — the
+                        # whole chain served without touching a block
+                        self.hotness.touch(key)
                     off += len(ids)
                     chain = chain + (key,)
                 self.hits += len(segments)
@@ -235,10 +303,19 @@ class PrefixCache:
                     _staged["chain_key"] = akey
                     _staged["created"] = []
                     _staged["memo_new"] = False
-                return CachedPrefix(
+                hit = CachedPrefix(
                     memo[0], memo[1], P, total, 0,
                     chain_key=akey if self.config.reuse == "exact" else None,
                 )
+            else:
+                hit = None
+        if hit is not None:
+            # memo-dominated traffic must still converge: a service whose
+            # live mix is all memo hits would otherwise never demote idle
+            # entries nor fire the cache→pool tier mirror (interval-gated,
+            # so this is one dict-scan every retier_interval_s at most)
+            self.retier()
+            return hit
 
         buf = self.engine.prefix_buffer_zero()
         off = 0
@@ -248,6 +325,9 @@ class PrefixCache:
         for key, ids in segments:
             seg_len = len(ids)
             ek = self._entry_key(key, off, chain)
+            planes: Optional[Tuple] = None
+            quantized = False
+            swap = None  # (stamp, score) when a cold entry needs a swap-in
             with self._lock:
                 e = self._entries.get(ek)
                 if e is not None and e.seg_len == seg_len:
@@ -255,6 +335,37 @@ class PrefixCache:
                     e.uses += 1
                 else:
                     e = None  # slot/length mismatch: treat as a miss
+                if self.tiering is not None:
+                    score = self.hotness.touch(key)
+                    if e is not None:
+                        if e.tier == "cold":
+                            swap = (e.stamp, score)
+                        elif (
+                            e.tier == "warm"
+                            and score >= self.tiering.warm_below
+                        ):
+                            # promotion roughly doubles this entry's device
+                            # bytes — re-enforce the budget or a
+                            # hit-dominated steady state (no inserts) could
+                            # sit over it indefinitely
+                            self._promote_locked(e)
+                            self._enforce_budget_locked(keep=ek)
+                # SNAPSHOT while still locked: tier transitions mutate the
+                # entry in place, so planes/quantized must never be re-read
+                # after release — a concurrent demote could hand the splice
+                # a None or a half-transitioned tuple
+                if e is not None and e.tier != "cold":
+                    planes, quantized = e.planes, e.quantized
+            if e is not None and swap is not None:
+                # host→HBM swap-in OUTSIDE the lock (the transfer must not
+                # serialize concurrent resolves); None = the swap failed
+                # (or the host buffer is gone) and the entry was dropped —
+                # fall through to recompute-from-tokens below
+                res = self._swap_in(ek, swap[0], _trigger, swap[1])
+                if res is None:
+                    e = None
+                else:
+                    planes, quantized = res
             if e is None:
                 # build with the true left context (buf holds chain's KV):
                 # under "exact" reuse this makes the block bit-faithful to
@@ -278,7 +389,13 @@ class PrefixCache:
             else:
                 n_hit += 1
                 reused += seg_len
-            buf = self.engine.splice_prefix(buf, e.planes, off)
+            if quantized and len(planes) == 4:
+                # warm entry on a non-int8 engine: rebuild native-dtype
+                # planes for the splice from the LOCKED snapshot (the
+                # tuple itself is immutable). The int8 round trip is the
+                # warm tier's bounded drift.
+                planes = dequantize_planes(planes, buf[0].dtype)
+            buf = self.engine.splice_prefix(buf, planes, off)
             off += seg_len
             chain = chain + (key,)
 
@@ -320,21 +437,32 @@ class PrefixCache:
                 if k == akey:
                     continue
                 self._pop_assembled(k)
+        # opportunistic tier maintenance (interval-gated; no-op untiered):
+        # demotions ride the resolve path so a quiet cache still converges
+        # without a dedicated thread — the lookahead sweeper's stage()
+        # calls and live resolves both pass through here
+        self.retier()
         return CachedPrefix(
             buf, off, P, reused, computed,
             chain_key=akey if self.config.reuse == "exact" else None,
         )
 
     # -- lookahead staging (rag/lookahead.py drives these) ---------------
-    def stage(self, segments: Sequence[Tuple[str, Sequence[int]]]):
+    def stage(self, segments: Sequence[Tuple[str, Sequence[int]]],
+              trigger: str = "lookahead"):
         """Resolve-and-track: exactly ``prefix_for`` (the miss path IS the
         populate path), but returns ``(CachedPrefix, staging_record)`` where
         the record names every entry/assembled buffer this call CREATED —
         the handle a superseded speculation passes to ``release_staged``.
         Blocks another request consumed in the meantime are NOT released
-        (their ``uses`` moved past the recorded creation value)."""
+        (their ``uses`` moved past the recorded creation value).
+
+        ``trigger`` attributes the resolve's cold-tier swap-ins: staging is
+        the lookahead pipeline's prestage, so a swap-in here happened OFF
+        the critical path — overlapped with the previous request's decode —
+        and counts toward the swap-in hide rate."""
         record: Dict = {}
-        cp = self.prefix_for(segments, _staged=record)
+        cp = self.prefix_for(segments, _staged=record, _trigger=trigger)
         if cp is None or not record:
             return cp, None
         return cp, record
@@ -360,6 +488,11 @@ class PrefixCache:
                     continue
                 self._entries.pop(ek)
                 self.entry_bytes -= e.nbytes
+                if self.spill is not None:
+                    # demote-while-prestaged: a staged entry that went cold
+                    # before the speculation died still releases its HOST
+                    # buffer (its device bytes were already spilled away)
+                    self.spill.drop(ek)
                 released += 1
             akey = record.get("chain_key")
             if record.get("memo_new") and akey in self._assembled:
@@ -370,6 +503,274 @@ class PrefixCache:
                 ):
                     released += 1
         return released
+
+    # -- hotness tiering (engine/tiering.py drives the representation) ----
+    def retier(self, force: bool = False) -> int:
+        """One tier-maintenance sweep: demote entries whose decayed hotness
+        fell under the thresholds (hot → warm int8 in place, any → cold
+        host spill). Interval-gated on the resolve path (``force=True``
+        ignores the gate — tests and service maintenance). Pinned entries
+        (the prompt head — reused by 100% of requests) never demote.
+        Returns the number of transitions performed.
+
+        Invariants preserved across every transition: the ``_Entry`` object
+        (and its creation stamp / use counter) is mutated in place, so the
+        PR-7 staging discipline and LRU identity survive; ``entry_bytes``
+        tracks device bytes exactly (a cold entry holds zero)."""
+        if self.tiering is None:
+            return 0
+        now = time.monotonic()
+        cold: List[tuple] = []  # (ek, planes snapshot) to spill off-lock
+        with self._lock:
+            if (
+                not force
+                and now - self._last_retier < self.tiering.retier_interval_s
+            ):
+                return 0
+            self._last_retier = now
+            moved = 0
+            for ek, e in list(self._entries.items()):
+                if e.pinned:
+                    continue
+                if e.tier == "cold" and ek not in self.spill:
+                    # the host store's budget evicted its backing: this
+                    # entry can never swap in again (its next use is a
+                    # plain miss either way) — drop the stub, or cold
+                    # entries accrete one dict node per chunk ever cached
+                    self._entries.pop(ek)
+                    continue
+                score = self.hotness.score(ek[0])
+                if e.tier != "cold" and score < self.tiering.cold_below:
+                    cold.append((ek, e.planes))
+                elif e.tier == "hot" and score < self.tiering.warm_below:
+                    # quantization only DISPATCHES device work (async) —
+                    # cheap to hold the lock across, unlike a D2H copy
+                    self._demote_warm_locked(e)
+                    moved += 1
+            self.hotness.prune()
+        for ek, planes in cold:
+            # the device→host copy of a multi-MiB chunk must not serialize
+            # concurrent resolves (the rule _swap_in applies in the other
+            # direction): copy OUTSIDE the lock, install under a short
+            # re-acquire gated on plane IDENTITY — an entry rebuilt,
+            # promoted, or already spilled meanwhile is skipped and the
+            # next sweep re-judges it
+            host = tuple(np.asarray(p) for p in planes)
+            with self._lock:
+                e = self._entries.get(ek)
+                if e is None or e.planes is not planes:
+                    continue
+                self._spill_host_locked(ek, e, host)
+                moved += 1
+        if moved and self.on_retier is not None:
+            try:
+                self.on_retier()
+            except Exception:  # noqa: BLE001 — maintenance must not fail a resolve
+                logger.exception("prefix-cache retier callback failed")
+        return moved
+
+    def force_demote(self, tier: str, seg_key: Optional[str] = None) -> int:
+        """Demote entries (all, or just ``seg_key``'s) to ``tier``
+        regardless of hotness — the bench's forced-demotion lever and the
+        quality-tolerance tests' setup hook. Pinned entries still never
+        demote. Returns the number of entries moved."""
+        if tier not in ("warm", "cold"):
+            raise ValueError(f"force_demote tier={tier!r}: expected warm|cold")
+        if self.tiering is None:
+            return 0
+        n = 0
+        with self._lock:
+            for ek, e in list(self._entries.items()):
+                if e.pinned or (seg_key is not None and ek[0] != seg_key):
+                    continue
+                if tier == "cold" and e.tier != "cold":
+                    self._demote_cold_locked(ek, e)
+                    n += 1
+                elif tier == "warm" and e.tier == "hot":
+                    self._demote_warm_locked(e)
+                    n += 1
+        return n
+
+    def _demote_warm_locked(self, e: _Entry) -> None:
+        """hot → warm: quantize the entry's planes to int8 IN PLACE (no
+        re-prefill — the bytes already in HBM convert; the old planes free
+        when their last reference drops). On an int8-KV engine the planes
+        are already int8, so warm is a tier label with no byte change."""
+        self._tier_counts["demotes_warm"] += 1
+        q = quantize_planes(e.planes)
+        e.tier = "warm"
+        if q is None:
+            return  # already int8 — label-only transition
+        self.entry_bytes -= e.nbytes
+        e.planes = q
+        e.quantized = True
+        e.nbytes = _planes_nbytes(q)
+        self.entry_bytes += e.nbytes
+
+    def _demote_cold_locked(self, ek, e: _Entry) -> None:
+        """(hot|warm) → cold: copy the planes to host RAM and drop the
+        device bytes. A hot entry spilled cold and swapped back is still
+        BYTE-EXACT — only the warm int8 round trip costs drift. The D2H
+        copy here runs UNDER the lock — acceptable for ``force_demote``
+        (a test/ops lever); the retier sweep copies outside it."""
+        self._spill_host_locked(
+            ek, e, tuple(np.asarray(p) for p in e.planes)
+        )
+
+    def _spill_host_locked(self, ek, e: _Entry, host: Tuple) -> None:
+        """Install an already-host-copied spill and zero the entry's
+        device residency (lock held by the caller)."""
+        self.spill.put(ek, host, meta={"quantized": e.quantized})
+        self.entry_bytes -= e.nbytes
+        e.planes = None
+        e.nbytes = 0
+        e.tier = "cold"
+        self._tier_counts["demotes_cold"] += 1
+
+    def _promote_locked(self, e: _Entry) -> None:
+        """warm → hot for an entry whose hotness recovered: materialize the
+        native-dtype planes so hits stop paying the per-resolve dequant.
+        The int8 drift is retained (the original bits are gone — exactness
+        returns only when the entry is rebuilt); an int8-KV engine's warm
+        entries promote by label alone."""
+        self._tier_counts["promotes"] += 1
+        if not e.quantized:
+            e.tier = "hot"
+            return
+        native = dequantize_planes(e.planes, self._native_dtype())
+        self.entry_bytes -= e.nbytes
+        e.planes = native
+        e.quantized = False
+        e.nbytes = _planes_nbytes(native)
+        e.tier = "hot"
+        self.entry_bytes += e.nbytes
+
+    def _swap_in(self, ek, stamp: int, trigger: str, score: float):
+        """cold → resident, performed OUTSIDE the cache lock: the host→HBM
+        transfer of a multi-MiB chunk must not serialize every concurrent
+        resolve (memo hits included). The spill store guards itself, the
+        device_put runs unlocked, and the result installs under a short
+        re-acquire gated on the entry's creation STAMP — a concurrent
+        rebuild or a second swap-in wins and this call's staged planes are
+        simply dropped. Returns ``(planes, quantized)`` ready to splice, or
+        None when the swap could not happen — the entry and its host buffer
+        are dropped and the caller RECOMPUTES FROM TOKENS (the chaos
+        contract: a failed swap-in is a cache miss, never an error).
+        ``kv_swap_in`` is the fault site."""
+
+        def _drop_if_ours():
+            e = self._entries.get(ek)
+            if e is not None and e.stamp == stamp and e.tier == "cold":
+                self._entries.pop(ek)
+
+        item = self.spill.get(ek)
+        if item is None:
+            # the host store evicted it (budget): an ordinary miss
+            with self._lock:
+                _drop_if_ours()
+            return None
+        try:
+            faults.maybe_fail("kv_swap_in")
+            planes = self._device_planes(item[0])
+        except Exception:  # recompute-from-tokens fallback; KeyboardInterrupt
+            # / SystemExit must PROPAGATE (nothing here is torn: the entry
+            # is still cold and the spill intact — a later resolve retries)
+            with self._lock:
+                self._tier_counts["swap_in_fallbacks"] += 1
+                e = self._entries.get(ek)
+                if e is None or (e.stamp == stamp and e.tier == "cold"):
+                    # ours (or an orphan): the host buffer releases with
+                    # the entry. A DIFFERENT entry rebuilt at this key
+                    # meanwhile may own a NEW spill — leave it alone, or a
+                    # failed swap would silently turn that cached chunk
+                    # into a recompute (same stamp aliasing every other
+                    # release path guards against)
+                    if e is not None:
+                        self._entries.pop(ek)
+                    self.spill.drop(ek)
+            logger.warning(
+                "kv swap-in failed for %r; falling back to recompute",
+                ek, exc_info=True,
+            )
+            return None
+        with self._lock:
+            e = self._entries.get(ek)
+            if e is None or e.stamp != stamp:
+                return None  # rebuilt/evicted meanwhile: plain miss
+            if e.tier != "cold":
+                # a concurrent swap-in won: serve ITS installed planes
+                return (e.planes, e.quantized)
+            self.spill.drop(ek)
+            e.planes = planes
+            e.nbytes = _planes_nbytes(planes)
+            e.tier = "warm" if e.quantized else "hot"
+            self.entry_bytes += e.nbytes
+            key = (
+                "swap_ins_lookahead" if trigger == "lookahead"
+                else "swap_ins_demand"
+            )
+            self._tier_counts[key] += 1
+            if e.tier == "warm" and score >= self.tiering.warm_below:
+                # the hit that triggered this swap already re-heated the
+                # chunk: promote in the same install (rehit contract)
+                self._promote_locked(e)
+            self._enforce_budget_locked(keep=ek)
+            return (e.planes, e.quantized)
+
+    def _device_planes(self, host: Tuple) -> Tuple:
+        """Host numpy planes back onto the device (replicated on a mesh —
+        the layout every entry built by ``build_segment_kv`` has)."""
+        import jax
+        import jax.numpy as jnp
+
+        planes = tuple(jnp.asarray(p) for p in host)
+        mesh = getattr(self.engine, "mesh", None)
+        if mesh is not None:
+            planes = tuple(
+                jax.device_put(p, mesh.replicated) for p in planes
+            )
+        return planes
+
+    def _native_dtype(self):
+        """The engine's native KV payload dtype (what splices consume)."""
+        return self.engine.prefix_buffer_zero()[0].dtype
+
+    def chain_tier(self, chain_key) -> str:
+        """The hotness tier of a whole CHAIN (a pool registration's unit —
+        ``(segment-key tuple, total)``): as cold as its coldest member
+        segment. Pure hotness math, no entry lookups — usable from any
+        thread for pool-side retier decisions."""
+        if self.tiering is None or chain_key is None:
+            return "hot"
+        chain = chain_key[0] if isinstance(chain_key, tuple) else chain_key
+        worst = "hot"
+        for seg in chain:
+            s = self.hotness.score(seg)
+            if s < self.tiering.cold_below:
+                return "cold"
+            if s < self.tiering.warm_below:
+                worst = "warm"
+        return worst
+
+    def tier_stats(self) -> Dict[str, float]:
+        """Per-tier residency + transition counters — the source of the
+        ``rag_kv_tier_*`` families (obs) and the bench's capacity math."""
+        out: Dict[str, float] = {
+            "tier_hot_entries": 0, "tier_warm_entries": 0,
+            "tier_cold_entries": 0, "tier_hot_bytes": 0,
+            "tier_warm_bytes": 0, "tier_cold_host_bytes": 0,
+            "tier_host_evictions": 0,
+        }
+        with self._lock:
+            for e in self._entries.values():
+                out[f"tier_{e.tier}_entries"] += 1
+                if e.tier != "cold":
+                    out[f"tier_{e.tier}_bytes"] += e.nbytes
+            out.update(self._tier_counts)
+        if self.spill is not None:
+            out["tier_cold_host_bytes"] = self.spill.bytes
+            out["tier_host_evictions"] = self.spill.evictions
+        return out
 
     # -- LRU bookkeeping -------------------------------------------------
     def _pop_assembled(self, key) -> bool:
@@ -384,36 +785,47 @@ class PrefixCache:
         return True
 
     def _insert(self, key, entry: _Entry) -> None:
-        budget = int(self.config.hbm_budget_mb) * (1 << 20)
         with self._lock:
             self._creation_seq += 1
             entry.stamp = self._creation_seq
             old = self._entries.pop(key, None)
             if old is not None:
                 self.entry_bytes -= old.nbytes
+                if self.spill is not None:
+                    self.spill.drop(key)  # a cold old entry's host buffer
             self._entries[key] = entry
             self.entry_bytes += entry.nbytes
-            # assembled buffers (pure re-splice avoidance) evict before any
-            # segment block does — a block eviction costs a real re-prefill
-            while (
-                self._assembled
-                and self.entry_bytes + self.assembled_bytes > budget
-            ):
-                self._pop_assembled(next(iter(self._assembled)))
-            # then evict LRU-first until under budget; pinned blocks (the
-            # head — reused by 100% of requests) are skipped, and the entry
-            # just inserted is never its own eviction victim
-            for k in list(self._entries):
-                if self.entry_bytes <= budget:
-                    break
-                if k == key or self._entries[k].pinned:
-                    continue
-                victim = self._entries.pop(k)
-                self.entry_bytes -= victim.nbytes
-                logger.debug("prefix cache evicted %r (%d bytes)", k, victim.nbytes)
+            self._enforce_budget_locked(keep=key)
+
+    def _enforce_budget_locked(self, keep) -> None:
+        """Evict down to the HBM budget (lock held). Assembled buffers
+        (pure re-splice avoidance) evict before any segment block does — a
+        block eviction costs a real re-prefill; then blocks evict
+        LRU-first. Pinned blocks (the head — reused by 100% of requests)
+        and ``keep`` (the entry just inserted / swapped in) are never
+        victims, and cold entries are skipped — they hold no device bytes
+        to reclaim."""
+        budget = int(self.config.hbm_budget_mb) * (1 << 20)
+        while (
+            self._assembled
+            and self.entry_bytes + self.assembled_bytes > budget
+        ):
+            self._pop_assembled(next(iter(self._assembled)))
+        for k in list(self._entries):
+            if self.entry_bytes <= budget:
+                break
+            e = self._entries[k]
+            if k == keep or e.pinned or e.tier == "cold":
+                continue
+            self._entries.pop(k)
+            self.entry_bytes -= e.nbytes
+            logger.debug("prefix cache evicted %r (%d bytes)", k, e.nbytes)
 
     def clear(self) -> None:
-        """Drop every cached block and assembled buffer (frees the HBM)."""
+        """Drop every cached block and assembled buffer (frees the HBM) —
+        and every cold-spilled host buffer with them: a cleared cache must
+        leave ZERO host-spill bookkeeping behind (the reset contract the
+        tiering chaos tests pin)."""
         with self._lock:
             self._entries.clear()
             self._assembled.clear()
@@ -421,3 +833,5 @@ class PrefixCache:
             self._assembled_stamp.clear()
             self.entry_bytes = 0
             self.assembled_bytes = 0
+            if self.spill is not None:
+                self.spill.clear()

@@ -48,6 +48,7 @@ from rag_llm_k8s_tpu.ops.attention import (
     decode_attention_xla_q8,
     flash_attention,
     paged_chunk_attention,
+    paged_chunk_attention_q8,
     paged_chunk_attention_xla,
     paged_chunk_attention_xla_q8,
     paged_decode_attention,
@@ -370,10 +371,10 @@ class Attention(nn.Module):
         per-device decode bandwidth scales as live_tokens × K/tp; the
         cross-shard reduce is the wo psum XLA already inserts, exactly as
         on the dense tp path. ``attn_impl="xla"`` (and head counts that
-        don't tile tp) takes the sharding-transparent gather-based oracle;
-        the q8 CHUNK case always does (paged_chunk_attention_xla_q8 —
-        chunk prefill is per-admission, the steady-state decode stays
-        fused)."""
+        don't tile tp) takes the sharding-transparent gather-based
+        oracles — every fused path (decode, chunk, and their q8 twins,
+        including the paged q8 chunk kernel that replaced PR 5's gather
+        oracle) has one."""
         from rag_llm_k8s_tpu.ops.attention import paged_partition_specs
 
         impl = self._resolved_impl()
@@ -441,7 +442,24 @@ class Attention(nn.Module):
         B = q.shape[0]
         wi = jnp.broadcast_to(jnp.asarray(write_index, jnp.int32), (B,))
         if scales is not None:
-            return paged_chunk_attention_xla_q8(
+            if use_xla:
+                return paged_chunk_attention_xla_q8(
+                    q, k, v, scales[0], scales[1], block_tables, kv_len,
+                    lay1, wi,
+                )
+            # fused q8 paged chunk prefill: warm-tier (int8) admission
+            # streams the int8 blocks directly with epilogue dequant —
+            # PR 5's gather oracle spent the bandwidth int8 bought
+            kernel = shard(
+                lambda q_, k_, v_, ks_, vs_, t_, l_, lay_, wi_: (
+                    paged_chunk_attention_q8(
+                        q_, k_, v_, ks_, vs_, t_, l_, lay_, wi_,
+                        interpret=interpret,
+                    )
+                ),
+                "chunk", True,
+            )
+            return kernel(
                 q, k, v, scales[0], scales[1], block_tables, kv_len, lay1, wi
             )
         if use_xla:

@@ -71,6 +71,9 @@ _RULES: Tuple[Tuple[re.Pattern, str], ...] = tuple(
         # paged-KV leg: dense→paged step-rate ratio and the
         # admittable-slots-at-fixed-HBM gain (ISSUE 5 acceptance numbers)
         (r"speedup|_gain$", "higher"),
+        # KV-tiering leg (ISSUE 8): servable-capacity multiplier at fixed
+        # HBM and the fraction of swap-ins hidden under decode
+        (r"effective_capacity_x|hide_rate", "higher"),
         # -- lower is better ----------------------------------------------
         (r"_ms($|\.|_)|_s$|seconds|_bytes$", "lower"),
     )

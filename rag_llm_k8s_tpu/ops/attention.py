@@ -1423,6 +1423,180 @@ def paged_chunk_attention(
     return out.reshape(B, H, S, hd).transpose(0, 2, 1, 3)
 
 
+def _paged_chunk_kernel_q8(
+    layer_ref,  # SMEM [1]
+    wi_ref,  # SMEM [B]: per-row logical slot of query 0
+    tables_ref,  # SMEM [B * MB]
+    kv_len_ref,  # SMEM [B]
+    q_ref,  # [1, bq, hd]
+    k_ref,  # [1, 1, 1, bs, hd] int8
+    v_ref,  # [1, 1, 1, bs, hd] int8
+    ks_ref,  # [1, 1, K, bs] fp32 — ALL kv heads' scales for this block
+    vs_ref,  # [1, 1, K, bs] fp32
+    o_ref,  # [1, bq, hd]
+    m_scr,  # VMEM [bq, 1]
+    l_scr,  # VMEM [bq, 1]
+    acc_scr,  # VMEM [bq, hd]
+    *,
+    bq: int,
+    bs: int,
+    scale: float,
+    num_heads: int,
+    group: int,
+):
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+    b = bh // num_heads
+    # same Mosaic tile workaround as _chunk_kernel_q8: a (1, bs) scale
+    # block is untileable, so the block carries all K heads' scales and
+    # the kernel row-selects its own kv head with an iota mask
+    kvh = (bh % num_heads) // group
+    wi = wi_ref[b]
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # block skip: logical blocks past the frontier or strictly above the
+    # OFFSET causal diagonal (query t sits at logical slot wi + t) do no work
+    q_hi = wi + qi * bq + bq - 1
+    live = (kj * bs < kv_len_ref[b]) & (kj * bs <= q_hi)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        # int8 payloads need NO validity masking (every bit pattern is
+        # finite); invalid columns die via the score mask + zeroed scales —
+        # dequantization rides the matmul EPILOGUES (score × k-scale,
+        # prob × v-scale) exactly as in the dense q8 chunk kernel, so
+        # warm-tier prefill keeps the bandwidth int8 bought instead of
+        # paying the gather oracle's
+        k = k_ref[0, 0, 0].astype(q.dtype)  # [bs, hd]
+        rows = jax.lax.broadcasted_iota(jnp.int32, ks_ref.shape[2:], 0)  # [K, bs]
+        ks_row = jnp.sum(jnp.where(rows == kvh, ks_ref[0, 0], 0.0), axis=0)
+        vs_row = jnp.sum(jnp.where(rows == kvh, vs_ref[0, 0], 0.0), axis=0)
+        cpos = kj * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        cok = cpos < kv_len_ref[b]
+        # scales CAN be NaN past the frontier (uninitialized fp32 memory)
+        ks = jnp.where(cok, ks_row[None, :], 0.0)  # [1, bs]
+        vs = jnp.where(cok, vs_row[None, :], 0.0)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale * ks  # [bq, bs]; ks broadcasts over the bq rows
+
+        q_pos = wi + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bs), 0)
+        k_pos = kj * bs + jax.lax.broadcasted_iota(jnp.int32, (bq, bs), 1)
+        ok = (k_pos < kv_len_ref[b]) & (k_pos <= q_pos)
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(ok, jnp.exp(s - m_new), 0.0)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = (p * vs).astype(q.dtype)  # V scale folded into the prob matrix
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            pv, v_ref[0, 0, 0].astype(q.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bq", "interpret"))
+def paged_chunk_attention_q8(
+    q: jax.Array,  # [B, S, H, hd] — one prompt chunk's fresh queries
+    k_arena: jax.Array,  # [L, N, K, bs, hd] int8
+    v_arena: jax.Array,  # [L, N, K, bs, hd] int8
+    k_scale: jax.Array,  # [L, N, K, bs] fp32
+    v_scale: jax.Array,  # [L, N, K, bs] fp32
+    block_tables: jax.Array,  # [B, MB] int32
+    kv_len: jax.Array,  # [B] int32
+    layer: jax.Array,  # [] or [1] int32
+    write_index: jax.Array,  # [B] int32: per-row logical slot of query 0
+    bq: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """``paged_chunk_attention`` over an int8 arena: the table indirection
+    of the paged chunk kernel + the epilogue dequantization of the q8
+    kernels. PR 5 left this path on the gather XLA oracle — which
+    materialized a dequantized logical view per layer, spending the
+    bandwidth the int8 arena bought; fused, warm-tier (int8) chunked
+    prefill streams the int8 blocks directly like every other q8 path."""
+    B, S, H, hd = q.shape
+    L, N, K, bs, _ = k_arena.shape
+    G = H // K
+    MB = block_tables.shape[1]
+    bq = _fit_block(S, bq)
+    if not interpret and bs % 32:
+        # int8 blocks need a 32-row second-to-minor tile on real hardware
+        raise ValueError(
+            f"paged block_size={bs} must be a multiple of the Mosaic 32-row "
+            "int8 tile under kv_quant='int8' (EngineConfig.kv_block_size)"
+        )
+
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, S, hd)
+    grid = (B * H, S // bq, MB)
+
+    def kv_index(bh, qi, kj, layer_ref, wi_ref, tables_ref, *s_):
+        return (
+            layer_ref[0],
+            tables_ref[(bh // H) * MB + kj],
+            (bh % H) // G,
+            0,
+            0,
+        )
+
+    def sc_index(bh, qi, kj, layer_ref, wi_ref, tables_ref, *s_):
+        return (layer_ref[0], tables_ref[(bh // H) * MB + kj], 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_chunk_kernel_q8, bq=bq, bs=bs, scale=hd**-0.5,
+            num_heads=H, group=G,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, hd), lambda bh, qi, kj, *s_: (bh, qi, 0)),
+                pl.BlockSpec((1, 1, 1, bs, hd), kv_index),
+                pl.BlockSpec((1, 1, 1, bs, hd), kv_index),
+                pl.BlockSpec((1, 1, K, bs), sc_index),
+                pl.BlockSpec((1, 1, K, bs), sc_index),
+            ],
+            out_specs=pl.BlockSpec((1, bq, hd), lambda bh, qi, kj, *s_: (bh, qi, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, hd), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        jnp.broadcast_to(jnp.asarray(write_index, jnp.int32), (B,)),
+        block_tables.astype(jnp.int32).reshape(-1),
+        kv_len.astype(jnp.int32),
+        qt,
+        k_arena,
+        v_arena,
+        k_scale,
+        v_scale,
+    )
+
+    return out.reshape(B, H, S, hd).transpose(0, 2, 1, 3)
+
+
 def paged_partition_specs(mode: str, q8: bool = False):
     """``(in_specs, out_spec)`` for ``shard_map``-ing the paged kernels over
     the ``tp`` mesh axis — THE partition rules of the head-sharded arena
@@ -1437,8 +1611,8 @@ def paged_partition_specs(mode: str, q8: bool = False):
       per-row, so one host table serves all shards).
 
     ``mode``: ``"decode"`` (args ``q, k, v[, ks, vs], tables, kv_len,
-    layer``) or ``"chunk"`` (args ``q, k, v, tables, kv_len, layer, wi``;
-    the q8 chunk path serves from its XLA oracle, so no q8 spec exists)."""
+    layer``) or ``"chunk"`` (args ``q, k, v[, ks, vs], tables, kv_len,
+    layer, wi``)."""
     from jax.sharding import PartitionSpec as P
 
     hspec = P(None, None, "tp", None)  # q / o: [B, S, H, hd]
@@ -1452,9 +1626,10 @@ def paged_partition_specs(mode: str, q8: bool = False):
         return (hspec, aspec, aspec, tspec, vspec, vspec), hspec
     if mode == "chunk":
         if q8:
-            raise ValueError(
-                "the paged q8 chunk path serves from its XLA oracle "
-                "(paged_chunk_attention_xla_q8) — no shard_map spec exists"
+            return (
+                (hspec, aspec, aspec, sspec, sspec, tspec, vspec, vspec,
+                 vspec),
+                hspec,
             )
         return (hspec, aspec, aspec, tspec, vspec, vspec, vspec), hspec
     raise ValueError(f"paged_partition_specs: unknown mode {mode!r}")
@@ -1577,12 +1752,11 @@ def paged_chunk_attention_xla_q8(
     layer: jax.Array,  # [] or [1] int32
     write_index: jax.Array,  # [B] int32
 ) -> jax.Array:
-    """Reference (and the serving fallback under int8-KV) for the paged
-    chunked-prefill path: gather + dequantize ONE layer's blocks, then the
-    bf16 oracle. Chunked prefill is a per-admission cost — the steady-state
-    bandwidth the int8 arena buys lives in the decode kernel, which stays
-    fully paged+fused; a dedicated q8 paged chunk kernel can land later
-    without touching callers."""
+    """Dense XLA reference for ``paged_chunk_attention_q8`` (oracle; the
+    off-TPU fallback): gather + dequantize ONE layer's blocks, then the
+    bf16 oracle. Serving uses the fused kernel above — this path
+    materializes a dequantized logical view per layer, spending the
+    bandwidth the int8 arena bought."""
     kd, vd = _dequant_paged_layer(
         k_arena, v_arena, k_scale, v_scale, block_tables, kv_len, layer, q.dtype
     )

@@ -108,7 +108,13 @@ class LookaheadExecutor:
     - ``headroom_fn()`` — False while speculative work would pressure live
       traffic (pool headroom / breaker / admission queue);
     - ``index_gen_fn()`` — the store's live vector count: a future launched
-      against an older index is stale and never served.
+      against an older index is stale and never served;
+    - ``tier_stats_fn()`` — the prefix cache's tier counters (KV tiering,
+      engine/tiering.py): the prestage path IS the cold-tier swap-in's
+      prefetch trigger (``PrefixCache.stage(trigger="lookahead")`` performs
+      any host→HBM swap-in on the worker thread, overlapped with the
+      previous request's decode), and ``stats()`` folds those counters into
+      the swap-in HIDE RATE the bench leg reports.
     """
 
     def __init__(
@@ -120,6 +126,7 @@ class LookaheadExecutor:
         headroom_fn: Optional[Callable[[], bool]] = None,
         index_gen_fn: Optional[Callable[[], int]] = None,
         registry=None,
+        tier_stats_fn: Optional[Callable[[], dict]] = None,
     ):
         self.config = config
         self.retrieve_fn = retrieve_fn
@@ -127,6 +134,7 @@ class LookaheadExecutor:
         self.release_fn = release_fn
         self.headroom_fn = headroom_fn
         self.index_gen_fn = index_gen_fn or (lambda: 0)
+        self.tier_stats_fn = tier_stats_fn
         self._lock = threading.Lock()
         self._futures: Dict[str, RetrievalFuture] = {}
         self._session_spec: Dict[str, RetrievalFuture] = {}
@@ -193,7 +201,9 @@ class LookaheadExecutor:
         self._m_prestaged = registry.counter(
             "rag_lookahead_prestaged_total",
             "resolved lookahead retrievals whose chunk KV was pre-staged "
-            "into prefix-cache entries / pool blocks",
+            "into prefix-cache entries / pool blocks (under KV tiering "
+            "this includes cold-tier host→HBM swap-ins performed off the "
+            "critical path — the swap-in hide mechanism)",
         )
         self._m_prestage_released = registry.counter(
             "rag_lookahead_prestage_released_total",
@@ -446,7 +456,7 @@ class LookaheadExecutor:
         joins = hit + late + miss
         launched = sum(c.value for c in self._m_launched.values())
         wasted = sum(c.value for c in self._m_wasted.values())
-        return {
+        out = {
             "launched": launched,
             "joins": joins,
             "hit_rate": (hit / joins) if joins else 0.0,
@@ -455,6 +465,23 @@ class LookaheadExecutor:
             "prestaged": self._m_prestaged.value,
             "prestage_released": self._m_prestage_released.value,
         }
+        if self.tier_stats_fn is not None:
+            # KV-tiering swap-in hide rate: swap-ins the prestage path
+            # performed off the critical path (trigger="lookahead") over
+            # all swap-ins — 1.0 means every cold chunk was resident again
+            # before its request's serving tail needed it
+            try:
+                ts = self.tier_stats_fn() or {}
+            except Exception:  # noqa: BLE001 — stats must never fail a scrape
+                ts = {}
+            hidden = float(ts.get("swap_ins_lookahead", 0))
+            demand = float(ts.get("swap_ins_demand", 0))
+            out["swap_ins_hidden"] = hidden
+            out["swap_ins_demand"] = demand
+            out["swap_in_hide_rate"] = (
+                hidden / (hidden + demand) if (hidden + demand) else 1.0
+            )
+        return out
 
     def shutdown(self) -> None:
         """Stop the workers and release every outstanding staging."""

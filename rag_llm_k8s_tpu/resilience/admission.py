@@ -78,6 +78,14 @@ class AdmissionController:
         # the concurrency cap still run: decode frees blocks every window,
         # and the scheduler's own backpressure orders them correctly.
         self.saturation_hint = None
+        # hotness-aware refinement of the saturation shed (KV tiering): a
+        # callable returning the RECLAIMABLE block count — registered
+        # prefix blocks in a non-hot tier, which the scheduler's next
+        # admission sweep returns to the pool without touching a live row.
+        # While that is positive, a saturated pool is cache warmth, not
+        # true pressure: the request QUEUES (bounded, deadline-aware)
+        # instead of shedding. Tier occupancy, not raw headroom, decides.
+        self.reclaimable_hint = None
 
     # -- internals -------------------------------------------------------
     def _reject(self, reason: str, status: int, retry_after_s: float):
@@ -103,7 +111,12 @@ class AdmissionController:
                 self._reject("queue_full", 429, self.retry_after_s)
             hint = self.saturation_hint
             if hint is not None and hint():
-                self._reject("pool_exhausted", 429, self.retry_after_s)
+                rec = self.reclaimable_hint
+                if rec is None or not rec():
+                    self._reject("pool_exhausted", 429, self.retry_after_s)
+                # else: the pool is full of demotable cache warmth — the
+                # scheduler reclaims it on its next sweep, so this request
+                # waits its bounded turn instead of bouncing a 429
             self.waiting += 1
             try:
                 while self.active >= self.max_concurrency:

@@ -56,9 +56,13 @@ __all__ = [
 #                  (rag/lookahead.py): a failed speculation must fall back
 #                  to the inline retrieve path and release everything it
 #                  staged — never fail the request.
+#   kv_swap_in   — a cold-tier host→HBM KV swap-in (engine/prefix_cache.py
+#                  and the paged prestage scatter): a failed swap must fall
+#                  back to recompute-from-tokens, release the host buffer,
+#                  and leak zero blocks on either substrate.
 SITES = (
     "store_lookup", "embed", "insert", "decode_step", "generate",
-    "lookahead_retrieve",
+    "lookahead_retrieve", "kv_swap_in",
 )
 
 ENV_VAR = "TPU_RAG_FAULTS"

@@ -160,6 +160,9 @@ class RagService:
         )
         if scheduler is not None and hasattr(scheduler, "breaker"):
             scheduler.breaker = self.breaker  # resets feed readiness
+        # per-scrape memo for the rag_kv_tier_* callback fan-out (see
+        # _pcache_tier_stats); must exist before any scrape can fire
+        self._tier_stats_memo = None
         self._init_observability()
         self.ready = False
         # per-stage in-flight counters, fed to the coalescers as
@@ -222,6 +225,21 @@ class RagService:
         pool = getattr(getattr(scheduler, "engine", None), "kv_pool", None)
         if pool is not None:
             self.admission.saturation_hint = lambda: pool.available() == 0
+            # KV tiering: tier occupancy refines the shed — while non-hot
+            # registered blocks exist, a dry pool is demotable cache
+            # warmth (the scheduler reclaims it on its next admission
+            # sweep), so the request queues instead of bouncing a 429
+            sched_eng = getattr(scheduler, "engine", None)
+            if hasattr(sched_eng, "reclaimable_blocks"):
+                self.admission.reclaimable_hint = (
+                    lambda: sched_eng.reclaimable_blocks() > 0
+                )
+        # tier state flows cache → pool: after any retier sweep that moved
+        # entries, mirror each registered chain's hotness tier onto the
+        # pool registrations (scheduler thread via run_on_engine)
+        pcache = getattr(engine, "prefix_cache", None)
+        if pcache is not None and getattr(pcache, "tiering", None) is not None:
+            pcache.on_retier = self._pool_retier
         # ONE EOS policy for ingest and query truncation alike: default the
         # runner's eos from the tokenizer so the two paths cannot diverge
         if encoder is not None and getattr(encoder, "eos_id", None) is None:
@@ -278,6 +296,11 @@ class RagService:
                 release_fn=self._lookahead_release,
                 headroom_fn=self._lookahead_headroom,
                 index_gen_fn=lambda: self.store.ntotal,
+                # KV tiering: stats() folds the cache's swap-in counters
+                # into the swap-in hide rate the bench leg reports —
+                # the FRESH reader, not the scrape memo (stats() callers
+                # expect current counters)
+                tier_stats_fn=self._pcache_tier_stats_fresh,
                 # the service's registry from the start: binding the
                 # process-wide default first would permanently retain the
                 # first executor (and this whole service graph) in the
@@ -359,6 +382,86 @@ class RagService:
                   fn=lambda: self._pcache_stat("prefix_cache_entries"))
         reg.gauge("prefix_cache_bytes",
                   fn=lambda: self._pcache_stat("prefix_cache_bytes"))
+        # hotness-aware KV tiering (engine/tiering.py, docs/KV_POOL.md):
+        # per-tier residency + transition/swap-in accounting, all
+        # callback-valued off PrefixCache.tier_stats() and the pool's tier
+        # ledger — families exist in every mode (zeros while tiering is
+        # off) so dashboards stay uniform
+        tier_entries = reg.labeled_gauge(
+            "rag_kv_tier_entries",
+            "cached chunk entries per hotness tier (hot bf16-native | "
+            "warm int8 | cold host-spilled)",
+        )
+        tier_bytes = reg.labeled_gauge(
+            "rag_kv_tier_bytes",
+            "bytes held per tier: hot/warm are device (HBM) bytes, cold "
+            "is host-spill RAM",
+        )
+        for t in ("hot", "warm", "cold"):
+            tier_entries.labels_callback(
+                lambda t=t: self._pcache_tier_stats().get(
+                    f"tier_{t}_entries", 0.0
+                ),
+                tier=t,
+            )
+            src = "tier_cold_host_bytes" if t == "cold" else f"tier_{t}_bytes"
+            tier_bytes.labels_callback(
+                lambda src=src: self._pcache_tier_stats().get(src, 0.0),
+                tier=t,
+            )
+        tier_tr = reg.labeled_counter(
+            "rag_kv_tier_transitions_total",
+            "tier transitions (change: demote_warm — in-place int8 "
+            "quantization; demote_cold — host spill; promote — back to "
+            "native residency)",
+        )
+        for change, key in (
+            ("demote_warm", "demotes_warm"),
+            ("demote_cold", "demotes_cold"),
+            ("promote", "promotes"),
+        ):
+            tier_tr.labels_callback(
+                lambda key=key: self._pcache_tier_stats().get(key, 0.0),
+                change=change,
+            )
+        tier_swap = reg.labeled_counter(
+            "rag_kv_tier_swap_ins_total",
+            "cold-tier host→HBM swap-ins (trigger: lookahead — prefetched "
+            "off the critical path, overlapped with decode; demand — paid "
+            "on a serving tail)",
+        )
+        for trig, key in (
+            ("lookahead", "swap_ins_lookahead"),
+            ("demand", "swap_ins_demand"),
+        ):
+            tier_swap.labels_callback(
+                lambda key=key: self._pcache_tier_stats().get(key, 0.0),
+                trigger=trig,
+            )
+        reg.counter(
+            "rag_kv_tier_swap_in_fallbacks_total",
+            "failed host→HBM swap-ins that fell back to "
+            "recompute-from-tokens (the chunk rebuilt like any miss; its "
+            "host buffer released)",
+            fn=lambda: self._pcache_tier_stats().get("swap_in_fallbacks", 0.0),
+        )
+        reg.gauge(
+            "rag_kv_tier_host_spill_bytes",
+            "host RAM held by cold-spilled chunk KV (bounded by "
+            "TPU_RAG_KV_TIERING_HOST_MB; oldest spills evict past it)",
+            fn=lambda: self._pcache_tier_stats().get("tier_cold_host_bytes", 0.0),
+        )
+        tier_pool = reg.labeled_gauge(
+            "rag_kv_tier_pool_blocks",
+            "paged-pool blocks by holder tier: hot/warm are registered "
+            "prefix chains (warm = reclaimable under pressure), rows are "
+            "live decode rows",
+        )
+        for t in ("hot", "warm", "rows"):
+            tier_pool.labels_callback(
+                lambda t=t: float(self._pool_tier_occupancy().get(t, 0)),
+                tier=t,
+            )
         # HTTP outcome accounting (route = matched path, code = status):
         # the availability SLO's good/total source, and the 5xx-rate panel
         self._m_http = reg.labeled_counter(
@@ -466,6 +569,58 @@ class RagService:
             if pcache is not None:
                 total += pcache.counters().get(name, 0)
         return total
+
+    def _pool_tier_occupancy(self) -> Dict[str, int]:
+        """The scheduler engine's registered-block tier ledger (scrape
+        thread safe — the pool guards it; empty dict when dense)."""
+        eng = getattr(self.scheduler, "engine", None)
+        occ = getattr(eng, "tier_occupancy", None)
+        return occ() if occ is not None else {}
+
+    def _pcache_tier_stats(self) -> Dict[str, float]:
+        """Summed ``PrefixCache.tier_stats()`` over the serving engines
+        (the rag_kv_tier_* families' source; zeros when tiering is off).
+        Memoized for a beat: ~13 label callbacks read this per scrape, and
+        each fresh compute takes every cache's lock — one snapshot serves
+        the whole scrape instead of contending 13× with the resolve path
+        (benign race on the memo: worst case two computes)."""
+        now = time.monotonic()
+        cached = self._tier_stats_memo
+        if cached is not None and now - cached[0] < 0.25:
+            return cached[1]
+        out = self._pcache_tier_stats_fresh()
+        self._tier_stats_memo = (now, out)
+        return out
+
+    def _pcache_tier_stats_fresh(self) -> Dict[str, float]:
+        """The unmemoized compute — programmatic readers (the lookahead
+        executor's ``stats()``, tests) expect CURRENT counters, not the
+        scrape memo's up-to-250ms-old snapshot."""
+        out: Dict[str, float] = {}
+        for e in self._engines().values():
+            pcache = getattr(e, "prefix_cache", None)
+            if pcache is not None and hasattr(pcache, "tier_stats"):
+                for k, v in pcache.tier_stats().items():
+                    out[k] = out.get(k, 0.0) + v
+        return out
+
+    def _pool_retier(self) -> None:
+        """Cache→pool tier mirror (PrefixCache.on_retier): re-tag every
+        registered chain with its chain's current hotness tier on the
+        dispatcher thread — a chain gone cold DROPS its registration
+        (blocks back to the pool; its KV survives in the host spill, one
+        prestage re-scatter away)."""
+        sched = self.scheduler
+        cache = getattr(self.engine, "prefix_cache", None)
+        if cache is None or not hasattr(sched, "run_on_engine"):
+            return
+
+        def _retier_task(e, _cache=cache):
+            retier = getattr(e, "retier_registrations", None)
+            if retier is not None:
+                retier(_cache.chain_tier)
+
+        sched.run_on_engine(_retier_task)
 
     def _prefix_bytes_by_device(self) -> Dict[int, int]:
         """{device_id: prefix-cache bytes} summed over the serving engines
@@ -882,8 +1037,16 @@ class RagService:
             # registration re-created at this key after ours was evicted.
             # A release task enqueued later runs after this one (FIFO on
             # the dispatcher), so it reads the settled value.
-            def _prestage_task(e, _h=handle, _cp=cp):
-                if e.prestage_prefix(_cp) == "registered":
+            # the registration carries the chain's CURRENT hotness tier
+            # (KV tiering): admission reclaims non-hot registrations first
+            cache = self.engine.prefix_cache
+            tier = (
+                cache.chain_tier(cp.chain_key)
+                if hasattr(cache, "chain_tier") else "hot"
+            )
+
+            def _prestage_task(e, _h=handle, _cp=cp, _tier=tier):
+                if e.prestage_prefix(_cp, tier=_tier) == "registered":
                     _h["pool"] = e.prestage_gen(_cp.chain_key)
 
             sched.run_on_engine(_prestage_task)

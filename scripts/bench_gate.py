@@ -54,6 +54,10 @@ REQUIRED_KEYS = (
     # ISSUE 7: the lookahead overlapped-query leg's headline — a dropped
     # leg must fail loudly, not read as "retrieval overlap unjudged"
     "lookahead_overlap.query_p50_overlap_ms",
+    # ISSUE 8: the KV-tiering capacity headline (servable cached chunks at
+    # fixed HBM, tiered vs hot-only; acceptance ≥ 3) — a dropped leg must
+    # never read as "tiering capacity unjudged"
+    "kv_tiering.effective_capacity_x",
 )
 
 

@@ -139,6 +139,30 @@ class TestReferenceParityDefaults:
         d = AppConfig.from_env({}).resilience
         assert d.deadline_ms == 120_000 and d.inflight_retries == 1
 
+    def test_from_env_kv_tiering(self):
+        c = AppConfig.from_env({
+            "TPU_RAG_KV_TIERING": "1",
+            "TPU_RAG_KV_TIERING_WARM_BELOW": "0.5",
+            "TPU_RAG_KV_TIERING_COLD_BELOW": "0.1",
+            "TPU_RAG_KV_TIERING_HALF_LIFE_S": "120",
+            "TPU_RAG_KV_TIERING_HOST_MB": "2048",
+            "TPU_RAG_KV_TIERING_INTERVAL_S": "2.5",
+        })
+        t = c.engine.kv_tiering
+        assert t.enabled and t.warm_below == 0.5 and t.cold_below == 0.1
+        assert t.half_life_s == 120.0 and t.host_spill_mb == 2048
+        assert t.retier_interval_s == 2.5
+        # off by default; cross-field rules enforced with the env applied
+        assert not AppConfig.from_env({}).engine.kv_tiering.enabled
+        for bad in (
+            {"TPU_RAG_KV_TIERING": "yes"},
+            {"TPU_RAG_KV_TIERING_COLD_BELOW": "0.9"},  # > warm_below
+            {"TPU_RAG_KV_TIERING_HALF_LIFE_S": "0"},
+            {"TPU_RAG_KV_TIERING_HOST_MB": "0"},
+        ):
+            with pytest.raises(ValueError):
+                AppConfig.from_env(bad)
+
     def test_from_env_resilience_validation(self):
         for bad in (
             {"TPU_RAG_ADMISSION_MAX_CONCURRENCY": "0"},

@@ -555,3 +555,41 @@ class TestPagedKernelParity:
         want = paged_decode_attention_xla_q8(*args)
         got = paged_decode_attention_q8(*args, interpret=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+    def test_paged_q8_chunk_kernel_matches_oracle(self):
+        """The FUSED q8 paged chunk-prefill kernel (it replaced PR 5's
+        gather-XLA oracle serving) against that oracle, interpret mode:
+        warm-tier chunked prefill streams int8 blocks with epilogue
+        dequant — per-row offset causality included."""
+        from rag_llm_k8s_tpu.ops.attention import (
+            paged_chunk_attention_q8,
+            paged_chunk_attention_xla_q8,
+        )
+
+        rng = np.random.default_rng(3)
+        B, S, H, K, hd, bs, MB = 2, 8, 4, 2, 16, 16, 4
+        N = 1 + B * MB
+        ka = rng.integers(-127, 128, (2, N, K, bs, hd)).astype(np.int8)
+        va = rng.integers(-127, 128, (2, N, K, bs, hd)).astype(np.int8)
+        ks = rng.uniform(0.001, 0.02, (2, N, K, bs)).astype(np.float32)
+        vs = rng.uniform(0.001, 0.02, (2, N, K, bs)).astype(np.float32)
+        tables = np.zeros((B, MB), np.int32)
+        kv_len = np.array([20, 41], np.int32)
+        wi = kv_len - S  # rows chunk at their own depths
+        phys = 1
+        for b in range(B):
+            for j in range(-(-int(kv_len[b]) // bs)):
+                tables[b, j] = phys
+                phys += 1
+        q = jnp.asarray(rng.standard_normal((B, S, H, hd)).astype(np.float32))
+        for lay in range(2):
+            args = (
+                q, jnp.asarray(ka), jnp.asarray(va), jnp.asarray(ks),
+                jnp.asarray(vs), jnp.asarray(tables), jnp.asarray(kv_len),
+                jnp.int32(lay), jnp.asarray(wi),
+            )
+            want = paged_chunk_attention_xla_q8(*args)
+            got = paged_chunk_attention_q8(*args, bq=4, interpret=True)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-4
+            )

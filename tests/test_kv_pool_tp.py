@@ -369,10 +369,55 @@ class TestShardedPagedKernelParity:
         want = paged_decode_attention_xla_q8(*args)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
 
-    def test_q8_chunk_spec_is_refused(self):
+    def test_sharded_paged_q8_chunk_matches_oracle(self):
+        """The fused q8 paged chunk kernel (it replaced PR 5's gather
+        oracle) under the SERVING partition specs — warm-tier chunked
+        prefill is shard-aware like every other paged path."""
+        from jax.experimental.shard_map import shard_map
+
+        from rag_llm_k8s_tpu.ops.attention import (
+            paged_chunk_attention_q8,
+            paged_chunk_attention_xla_q8,
+            paged_partition_specs,
+        )
+
+        rng = np.random.default_rng(3)
+        B, S, H, K, hd, bs, MB = 2, 8, 4, 2, 16, 16, 4
+        L, N = 2, 1 + 2 * MB
+        ka = rng.integers(-127, 128, (L, N, K, bs, hd)).astype(np.int8)
+        va = rng.integers(-127, 128, (L, N, K, bs, hd)).astype(np.int8)
+        ks = rng.uniform(0.001, 0.02, (L, N, K, bs)).astype(np.float32)
+        vs = rng.uniform(0.001, 0.02, (L, N, K, bs)).astype(np.float32)
+        kv_len = np.array([20, 41], np.int32)
+        wi = kv_len - S
+        tables = self._tables(B, MB, bs, kv_len)
+        q = jnp.asarray(rng.standard_normal((B, S, H, hd)).astype(np.float32))
+        in_specs, out_spec = paged_partition_specs("chunk", q8=True)
+        fn = shard_map(
+            lambda q_, k_, v_, ks_, vs_, t_, l_, lay_, wi_: (
+                paged_chunk_attention_q8(
+                    q_, k_, v_, ks_, vs_, t_, l_, lay_, wi_, bq=4,
+                    interpret=True,
+                )
+            ),
+            mesh=self._mesh(), in_specs=in_specs, out_specs=out_spec,
+            check_rep=False,
+        )
+        lay1 = jnp.asarray(1, jnp.int32).reshape(1)
+        args = (
+            q, jnp.asarray(ka), jnp.asarray(va), jnp.asarray(ks),
+            jnp.asarray(vs), jnp.asarray(tables), jnp.asarray(kv_len), lay1,
+            jnp.asarray(wi),
+        )
+        got = fn(*args)
+        want = paged_chunk_attention_xla_q8(*args)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+    def test_unknown_mode_spec_is_refused(self):
         from rag_llm_k8s_tpu.ops.attention import paged_partition_specs
 
-        with pytest.raises(ValueError, match="oracle"):
-            paged_partition_specs("chunk", q8=True)
+        # the q8 chunk spec EXISTS since the fused kernel landed
+        in_specs, _ = paged_partition_specs("chunk", q8=True)
+        assert len(in_specs) == 9
         with pytest.raises(ValueError, match="unknown mode"):
             paged_partition_specs("prefill")

@@ -1,0 +1,730 @@
+"""Hotness-aware KV tiering (ISSUE 8): tier transitions, swap-in, parity.
+
+The contracts under test (engine/tiering.py, engine/prefix_cache.py,
+docs/KV_POOL.md "hotness-aware tiering"):
+
+- **Hotness**: decayed hit-frequency per chunk key — exact decay math on an
+  injectable clock; scores drive every tier decision.
+- **Transitions**: hot → warm quantizes IN PLACE to int8 (device bytes
+  drop, no re-prefill), any → cold spills to host RAM (zero device bytes),
+  swap-in restores residency. The `_Entry` object survives every
+  transition (PR 7's creation-stamp staging discipline holds across
+  tiers), pinned entries never demote, and `clear()` leaves zero
+  host-spill bookkeeping behind.
+- **Parity** (`make tiering-smoke`): with tiering ENABLED and every chain
+  hot, greedy streams are BYTE-IDENTICAL to tiering-off on both substrates
+  (splice buffers and paged pool blocks); a hot→cold→swap-in round trip is
+  also byte-exact (the spill stores the exact planes); forced WARM
+  demotion keeps last-token logits within the pinned int8 tolerance.
+- **Chaos**: a failed host→HBM swap-in (fault site ``kv_swap_in``) falls
+  back to recompute-from-tokens, releases the host buffer, and leaks zero
+  pool blocks.
+- **Pool side**: registrations carry tiers; non-hot registrations are
+  reclaimed by admission pressure first; reset() zeroes the tier ledgers.
+"""
+
+import dataclasses
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rag_llm_k8s_tpu.core.config import (
+    DTypePolicy,
+    EngineConfig,
+    KVTieringConfig,
+    LlamaConfig,
+    PrefixCacheConfig,
+    SamplingConfig,
+)
+from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine
+from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+from rag_llm_k8s_tpu.engine.prefix_cache import PrefixCache
+from rag_llm_k8s_tpu.engine.tiering import (
+    HostSpillStore,
+    HotnessTracker,
+    dequantize_planes,
+    quantize_planes,
+)
+from rag_llm_k8s_tpu.resilience import faults
+
+FP32 = DTypePolicy.fp32()
+GREEDY = SamplingConfig(do_sample=False, max_new_tokens=6)
+
+PC = PrefixCacheConfig(
+    enabled=True, max_prefix_tokens=48, segment_buckets=(16,),
+    suffix_buckets=(16,), hbm_budget_mb=64,
+)
+# thresholds chosen so a single touch (score 1.0) is HOT and demotions
+# only ever happen through force_demote / an explicit retier with decayed
+# scores — the all-hot parity tests must see zero spontaneous transitions
+TIERING = KVTieringConfig(
+    enabled=True, warm_below=0.25, cold_below=0.0625,
+    half_life_s=3600.0, retier_interval_s=0.0,
+)
+# warm_below above any reachable touch score: demoted-warm entries STAY
+# warm across hits (serve through the dequant-at-splice path) instead of
+# promoting on the first touch — the sticky config the warm-quality tests
+# use to observe steady-state warm serving
+STICKY_WARM = dataclasses.replace(
+    TIERING, warm_below=1e9, cold_below=0.01, retier_interval_s=3600.0
+)
+
+
+def _engine(cfg, params, tiering=None, kv_quant="bf16"):
+    ec = EngineConfig(
+        prompt_buckets=(64,), max_batch_size=2, speculative="off",
+        max_seq_len=128, prefix_cache=PC, kv_quant=kv_quant,
+        kv_tiering=tiering if tiering is not None else KVTieringConfig(),
+    )
+    return InferenceEngine(
+        cfg, params, sampling=GREEDY, engine_config=ec, dtypes=FP32
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny(vocab_size=128)
+    params = init_params = jax.random.PRNGKey(0)
+    from rag_llm_k8s_tpu.models.llama import init_llama_params
+
+    params = init_llama_params(init_params, cfg, FP32)
+    return cfg, params
+
+
+def _segments(cfg, rng, tag):
+    head = [cfg.bos_token_id] + list(map(int, rng.integers(3, 120, 7)))
+    chunk = list(map(int, rng.integers(3, 120, 11)))
+    return [(f"head:{tag}", head), (f"chunk:{tag}", chunk)]
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+class TestHotness:
+    def test_decay_math_is_exact(self):
+        t = {"now": 0.0}
+        h = HotnessTracker(half_life_s=10.0, clock=lambda: t["now"])
+        assert h.touch("a") == 1.0
+        t["now"] = 10.0  # one half-life
+        assert h.score("a") == pytest.approx(0.5)
+        assert h.touch("a") == pytest.approx(1.5)
+        t["now"] = 30.0  # two more half-lives
+        assert h.score("a") == pytest.approx(1.5 / 4)
+        assert h.score("never-seen") == 0.0
+
+    def test_prune_drops_decayed_keys(self):
+        t = {"now": 0.0}
+        h = HotnessTracker(half_life_s=1.0, clock=lambda: t["now"])
+        h.touch("a")
+        h.touch("b")
+        t["now"] = 60.0  # 60 half-lives: ~1e-18
+        assert h.prune() == 2
+        assert len(h) == 0
+
+
+class TestHostSpillStore:
+    def test_budget_evicts_oldest_first(self):
+        s = HostSpillStore(budget_mb=1)
+        big = (np.zeros(600 * 1024, np.uint8),)
+        s.put("a", big)
+        s.put("b", big)  # over 1 MiB: "a" evicts
+        assert "a" not in s and "b" in s
+        assert s.evictions == 1
+        assert s.bytes == big[0].nbytes
+
+    def test_drop_and_clear_release_bytes(self):
+        s = HostSpillStore(budget_mb=4)
+        s.put("a", (np.zeros(64, np.uint8),), meta={"quantized": False})
+        host, meta = s.get("a")
+        assert meta == {"quantized": False} and host[0].nbytes == 64
+        assert s.drop("a") and not s.drop("a")
+        s.put("b", (np.zeros(64, np.uint8),))
+        s.clear()
+        assert s.bytes == 0 and len(s) == 0
+
+    def test_quantize_dequantize_round_trip_bounded(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 1, 2, 16, 8)).astype(np.float32)
+        planes = (jnp.asarray(x), jnp.asarray(x * 0.5))
+        q = quantize_planes(planes)
+        assert q is not None and len(q) == 4 and q[0].dtype == jnp.int8
+        back = dequantize_planes(q, jnp.float32)
+        # symmetric per-vector scales bound the error at max|x|/254
+        for orig, rec in zip(planes, back):
+            bound = np.abs(np.asarray(orig)).max(axis=-1, keepdims=True) / 254.0
+            assert np.all(np.abs(np.asarray(orig) - np.asarray(rec)) <= bound + 1e-7)
+        # already-int8 tuples decline (int8-KV engines: label-only warm)
+        assert quantize_planes(q[:2]) is None
+
+
+# ---------------------------------------------------------------------------
+# tier transitions on the stub substrate (no compiles)
+# ---------------------------------------------------------------------------
+
+
+class _StubEngine:
+    """Host-only engine stand-in with REAL fp32 plane tuples, so warm
+    quantization and cold spill exercise the actual byte paths."""
+
+    def __init__(self, tokens=16):
+        self.tokens = tokens
+        rng = np.random.default_rng(7)
+        self._proto = rng.standard_normal((2, 1, 2, tokens, 8)).astype(np.float32)
+
+    def prefix_buffer_zero(self):
+        return (jnp.zeros((2, 1, 2, 64, 8), jnp.float32),) * 2
+
+    def build_segment_kv(self, ids, ctx, off):
+        base = jnp.asarray(self._proto) * (1 + len(ids))
+        return (base, base * 0.5)
+
+    def splice_prefix(self, buf, block, off):
+        return buf
+
+
+def _cache(**tier_kw):
+    cfg = PrefixCacheConfig(
+        enabled=True, max_prefix_tokens=4096, segment_buckets=(64,),
+        suffix_buckets=(128,), hbm_budget_mb=64,
+    )
+    t = dataclasses.replace(TIERING, **tier_kw)
+    return PrefixCache(cfg, _StubEngine(), tiering=t)
+
+
+SEGS = [("head", list(range(8))), ("chunk:a", list(range(16)))]
+
+
+class TestTierTransitions:
+    def test_demote_warm_shrinks_device_bytes_in_place(self):
+        c = _cache()
+        c.prefix_for(SEGS)
+        hot = c.tier_stats()
+        assert hot["tier_hot_entries"] == 2
+        stamps = {k: e.stamp for k, e in c._entries.items()}
+        assert c.force_demote("warm") == 2
+        warm = c.tier_stats()
+        assert warm["tier_warm_entries"] == 2 and warm["tier_hot_entries"] == 0
+        assert warm["tier_warm_bytes"] < hot["tier_hot_bytes"]
+        assert warm["demotes_warm"] == 2
+        # in-place: same entry objects, same stamps (staging discipline)
+        assert {k: e.stamp for k, e in c._entries.items()} == stamps
+        assert c.entry_bytes == warm["tier_warm_bytes"]
+
+    def test_demote_cold_spills_and_swap_in_restores_exactly(self):
+        c = _cache()
+        c.prefix_for(SEGS)
+        orig = {
+            k: tuple(np.asarray(p) for p in e.planes)
+            for k, e in c._entries.items()
+        }
+        assert c.force_demote("cold") == 2
+        st = c.tier_stats()
+        assert st["tier_cold_entries"] == 2 and c.entry_bytes == 0
+        assert st["tier_cold_host_bytes"] > 0
+        c._assembled.clear(); c.assembled_bytes = 0  # force past the memo
+        cp = c.prefix_for(SEGS)
+        assert cp.computed_tokens == 0  # swap-in, never re-prefill
+        st = c.tier_stats()
+        assert st["swap_ins_demand"] == 2 and st["tier_cold_host_bytes"] == 0
+        # a hot→cold→swap-in round trip is BYTE-exact
+        for k, planes in orig.items():
+            e = c._entries[k]
+            assert e.tier == "hot" and not e.quantized
+            for a, b in zip(planes, e.planes):
+                np.testing.assert_array_equal(a, np.asarray(b))
+
+    def test_warm_then_cold_swap_in_restores_warm(self):
+        c = _cache(warm_below=STICKY_WARM.warm_below,
+                   cold_below=STICKY_WARM.cold_below)
+        c.prefix_for(SEGS)
+        c.force_demote("warm")
+        warm_bytes = c.entry_bytes
+        c.force_demote("cold")
+        c._assembled.clear(); c.assembled_bytes = 0
+        cp = c.prefix_for(SEGS)
+        assert cp is not None and cp.computed_tokens == 0
+        st = c.tier_stats()
+        # quantized planes spill and restore as warm (dequant on splice)
+        assert st["tier_warm_entries"] == 2
+        assert c.entry_bytes == warm_bytes
+
+    def test_rehit_promotes_swapped_in_warm_entry(self):
+        """Under the DEFAULT thresholds a hit is hotness: the same resolve
+        that swaps a quantized entry back in promotes it to hot (the
+        dequantized copy is materialized; the int8 drift is retained until
+        the entry is rebuilt)."""
+        c = _cache()
+        c.prefix_for(SEGS)
+        c.force_demote("warm")
+        c.force_demote("cold")
+        c._assembled.clear(); c.assembled_bytes = 0
+        cp = c.prefix_for(SEGS)
+        assert cp is not None and cp.computed_tokens == 0
+        st = c.tier_stats()
+        assert st["tier_hot_entries"] == 2 and st["promotes"] == 2
+        assert all(not e.quantized for e in c._entries.values())
+
+    def test_retier_uses_decayed_scores_and_pins_survive(self):
+        t = {"now": 0.0}
+        c = _cache(half_life_s=10.0)
+        c.hotness = HotnessTracker(10.0, clock=lambda: t["now"])
+        c.pin("head")
+        c.prefix_for(SEGS)
+        t["now"] = 25.0  # 2.5 half-lives: score ~0.177 → warm band
+        assert c.retier(force=True) == 1  # chunk only — head is pinned
+        assert c._entries[("head", 0, ())].tier == "hot"
+        t["now"] = 60.0  # score ~0.0156 → cold band
+        c.retier(force=True)
+        st = c.tier_stats()
+        assert st["tier_cold_entries"] == 1
+        assert c._entries[("head", 0, ())].tier == "hot"
+
+    def test_promotion_on_rehit(self):
+        t = {"now": 0.0}
+        c = _cache(half_life_s=10.0)
+        c.hotness = HotnessTracker(10.0, clock=lambda: t["now"])
+        c.prefix_for(SEGS)
+        t["now"] = 25.0
+        c.retier(force=True)
+        assert c.tier_stats()["tier_warm_entries"] == 2
+        c._assembled.clear(); c.assembled_bytes = 0
+        c.prefix_for(SEGS)  # touch → scores back over warm_below → promote
+        st = c.tier_stats()
+        assert st["tier_hot_entries"] == 2 and st["promotes"] == 2
+
+    def test_demote_while_prestaged_release_discipline(self):
+        """PR 7's creation-stamp staging must hold across tiers: a staged
+        entry demoted COLD before the speculation dies still releases —
+        including its host buffer; one another request consumed does not."""
+        c = _cache()
+        cp, record = c.stage(SEGS)
+        assert cp is not None and record
+        c.force_demote("cold")
+        assert len(c.spill) == 2
+        released = c.release_staged(record)
+        assert released >= 2
+        assert len(c.spill) == 0  # host buffers went with the entries
+        assert len(c._entries) == 0
+
+        # consumed-since-staging: the entry (and its spill) survive
+        cp, record = c.stage(SEGS)
+        c._assembled.clear(); c.assembled_bytes = 0
+        c.prefix_for(SEGS)  # a live request consumed the staged entries
+        c.force_demote("cold")
+        c.release_staged(record)
+        assert len(c._entries) == 2 and len(c.spill) == 2
+
+    def test_clear_clears_host_spill_bookkeeping(self):
+        c = _cache()
+        c.prefix_for(SEGS)
+        c.force_demote("cold")
+        assert c.spill.bytes > 0
+        c.clear()
+        assert c.spill.bytes == 0 and len(c.spill) == 0
+        assert c.tier_stats()["tier_cold_host_bytes"] == 0
+
+    def test_swap_in_fault_falls_back_to_recompute(self):
+        c = _cache()
+        c.prefix_for(SEGS)
+        c.force_demote("cold")
+        c._assembled.clear(); c.assembled_bytes = 0
+        faults.arm("kv_swap_in", times=1)
+        try:
+            cp = c.prefix_for(SEGS)
+        finally:
+            faults.clear()
+        assert cp is not None
+        st = c.tier_stats()
+        assert st["swap_in_fallbacks"] == 1
+        # ONE segment recomputed (8 head tokens), the other swapped in
+        assert cp.computed_tokens == 8
+        assert st["swap_ins_demand"] == 1
+        # the failed entry's host buffer was released with it
+        assert len(c.spill) == 0
+
+    def test_host_store_eviction_is_an_ordinary_miss(self):
+        c = _cache(host_spill_mb=1)
+        c.spill = HostSpillStore(budget_mb=1)
+        c.prefix_for(SEGS)
+        c.force_demote("cold")
+        c.spill.clear()  # model the budget having evicted everything
+        c._assembled.clear(); c.assembled_bytes = 0
+        cp = c.prefix_for(SEGS)
+        assert cp is not None and cp.computed_tokens == 24  # full rebuild
+        assert c.tier_stats()["swap_in_fallbacks"] == 0  # not a failure
+
+    def test_bytes_by_device_survives_cold_entries(self):
+        """A /metrics scrape must not die on a cold entry's planes=None —
+        the per-device gauge attributes only RESIDENT bytes (regression:
+        this raised TypeError and zeroed the gauge for every device)."""
+        c = _cache()
+        c.prefix_for(SEGS)
+        resident = sum(c.bytes_by_device().values())
+        assert resident > 0
+        c.force_demote("cold")
+        by_dev = c.bytes_by_device()  # must not raise
+        # only the assembled memo's bytes remain on device
+        assert sum(by_dev.values()) == c.assembled_bytes
+
+    def test_retier_prunes_cold_entries_without_host_backing(self):
+        """A cold entry whose spill buffer fell off the host budget can
+        never swap in again — the sweep drops the stub instead of letting
+        cold entries accrete one dict node per chunk ever cached."""
+        c = _cache()
+        c.prefix_for(SEGS)
+        c.force_demote("cold")
+        assert len(c._entries) == 2
+        c.spill.clear()  # model host-budget eviction of the backing
+        c.retier(force=True)
+        assert len(c._entries) == 0
+
+    def test_lookahead_trigger_attribution_and_hide_rate(self):
+        c = _cache()
+        c.prefix_for(SEGS)
+        c.force_demote("cold")
+        c._assembled.clear(); c.assembled_bytes = 0
+        cp, record = c.stage(SEGS)  # the prestage path: trigger=lookahead
+        assert cp.computed_tokens == 0
+        st = c.tier_stats()
+        assert st["swap_ins_lookahead"] == 2 and st["swap_ins_demand"] == 0
+        # the executor folds these into the hide rate
+        from rag_llm_k8s_tpu.core.config import LookaheadConfig
+        from rag_llm_k8s_tpu.rag.lookahead import LookaheadExecutor
+
+        ex = LookaheadExecutor(
+            LookaheadConfig(enabled=True, max_workers=1),
+            retrieve_fn=lambda text: [],
+            tier_stats_fn=c.tier_stats,
+        )
+        try:
+            stats = ex.stats()
+            assert stats["swap_in_hide_rate"] == 1.0
+            assert stats["swap_ins_hidden"] == 2
+        finally:
+            ex.shutdown()
+
+
+class TestConcurrency:
+    def test_promote_while_serving_stays_consistent(self):
+        """Resolves racing retier demotions/promotions: every resolve must
+        return a full-length prefix and the byte ledgers must balance."""
+        c = _cache(half_life_s=0.001, retier_interval_s=0.0)
+        errors = []
+        stop = threading.Event()
+
+        def serve():
+            try:
+                while not stop.is_set():
+                    cp = c.prefix_for(SEGS)
+                    assert cp is not None and cp.length == 24
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        def churn():
+            try:
+                while not stop.is_set():
+                    c.force_demote("warm")
+                    c.force_demote("cold")
+                    c._assembled.clear()
+                    c.assembled_bytes = 0
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=serve) for _ in range(2)] + [
+            threading.Thread(target=churn)
+        ]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors
+        st = c.tier_stats()
+        resident = st["tier_hot_bytes"] + st["tier_warm_bytes"]
+        assert c.entry_bytes == resident >= 0
+
+
+# ---------------------------------------------------------------------------
+# real-engine parity (make tiering-smoke runs this class)
+# ---------------------------------------------------------------------------
+
+
+class TestSmoke:
+    def test_all_hot_streams_byte_identical_both_substrates(self, tiny):
+        """Tiering ON with every chain hot is BYTE-IDENTICAL to tiering
+        off — splice-buffer substrate (generate_prefixed) and paged pool
+        substrate (admit_prefixed) alike."""
+        cfg, params = tiny
+        rng = np.random.default_rng(3)
+        segments = _segments(cfg, rng, "smoke")
+        suffix = list(map(int, rng.integers(3, 120, 5)))
+
+        off = _engine(cfg, params)
+        on = _engine(cfg, params, tiering=TIERING)
+        cp_off = off.prefix_cache.prefix_for(segments)
+        cp_on = on.prefix_cache.prefix_for(segments)
+        assert on.prefix_cache.tier_stats()["tier_hot_entries"] == 2
+        got_off = off.generate_prefixed(suffix, cp_off)
+        got_on = on.generate_prefixed(suffix, cp_on)
+        assert got_on == got_off
+
+        # paged pool substrate
+        paged_cfg = dataclasses.replace(
+            on.engine_config, kv_paged=True, kv_block_size=16
+        )
+        cont = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=paged_cfg, dtypes=FP32
+        )
+        _, fin = cont.admit_prefixed(1, suffix, cp_on, max_new=6)
+        outs = {}
+        while cont.has_active():
+            for rid, toks in cont.step():
+                outs[rid] = toks
+        got_paged = fin if fin is not None else outs[1]
+        full = [t for _, seg in segments for t in seg] + suffix
+        want = off.generate([full])[0]
+        assert got_off == want and got_paged == want
+
+    def test_cold_swap_in_stream_byte_identical(self, tiny):
+        """hot → cold → swap-in round-trips the EXACT planes: the greedy
+        stream after a swap-in matches the never-demoted stream byte for
+        byte (only warm's int8 round trip costs drift)."""
+        cfg, params = tiny
+        rng = np.random.default_rng(5)
+        segments = _segments(cfg, rng, "cold")
+        suffix = list(map(int, rng.integers(3, 120, 6)))
+        eng = _engine(cfg, params, tiering=TIERING)
+        cache = eng.prefix_cache
+        cp = cache.prefix_for(segments)
+        want = eng.generate_prefixed(suffix, cp)
+        assert cache.force_demote("cold") == 2
+        cache._assembled.clear(); cache.assembled_bytes = 0
+        cp2 = cache.prefix_for(segments)
+        assert cp2.computed_tokens == 0
+        assert cache.tier_stats()["swap_ins_demand"] == 2
+        assert eng.generate_prefixed(suffix, cp2) == want
+
+    def test_forced_warm_demotion_logits_within_tolerance(self, tiny):
+        """Warm (int8) chunks serve within the pinned logit tolerance: the
+        spliced-prefix last-token logits move by less than INT8_LOGIT_ATOL
+        vs the all-hot resolve, and stay far from zero-information."""
+        cfg, params = tiny
+        rng = np.random.default_rng(7)
+        segments = _segments(cfg, rng, "warm")
+        suffix = list(map(int, rng.integers(3, 120, 6)))
+        eng = _engine(cfg, params, tiering=STICKY_WARM)
+        cache = eng.prefix_cache
+        cp_hot = cache.prefix_for(segments)
+        assert cache.force_demote("warm") == 2
+        cache._assembled.clear(); cache.assembled_bytes = 0
+        cp_warm = cache.prefix_for(segments)
+        assert cp_warm.computed_tokens == 0  # dequant, never re-prefill
+        assert cache.tier_stats()["tier_warm_entries"] == 2
+
+        def last_logits(cp):
+            from rag_llm_k8s_tpu.models.llama import KVCache, make_kv_cache
+
+            T, S_suf = 64, 16
+            n = cp.length + len(suffix)
+            cache_d = make_kv_cache(cfg, 1, T, jnp.float32)
+            planes = tuple(
+                jax.lax.dynamic_update_slice(c, b, (0,) * c.ndim)
+                for c, b in zip((cache_d.k, cache_d.v), cp.planes)
+            )
+            toks = np.zeros((1, S_suf), np.int32)
+            toks[0, : len(suffix)] = suffix
+            pos = (cp.length + jnp.arange(S_suf, dtype=jnp.int32))[None, :]
+            logits, _ = eng.model_chunked.apply(
+                {"params": eng.params}, jnp.asarray(toks), pos,
+                KVCache(*planes), jnp.zeros((1,), jnp.int32),
+                jnp.full((1,), n, jnp.int32), jnp.int32(cp.length),
+                logit_index=jnp.int32(len(suffix) - 1),
+            )
+            return np.asarray(logits[0, -1])
+
+        hot_l, warm_l = last_logits(cp_hot), last_logits(cp_warm)
+        INT8_LOGIT_ATOL = 0.15  # the pinned warm-tier quality contract
+        np.testing.assert_allclose(warm_l, hot_l, atol=INT8_LOGIT_ATOL)
+        assert np.abs(warm_l - hot_l).max() > 0  # it DID go through int8
+
+    def test_mixed_tier_rows_share_one_paged_admission_group(self, tiny):
+        """One admission group with a hot-prefix row and a warm-prefix row
+        (mixed bf16/int8-history rows): both serve; the hot row's stream
+        stays byte-identical to a plain full-prompt admission."""
+        cfg, params = tiny
+        rng = np.random.default_rng(11)
+        seg_hot = _segments(cfg, rng, "mixhot")
+        seg_warm = _segments(cfg, rng, "mixwarm")
+        suffix = list(map(int, rng.integers(3, 120, 6)))
+        eng = _engine(cfg, params, tiering=STICKY_WARM)
+        cache = eng.prefix_cache
+        cache.prefix_for(seg_warm)
+        cache.force_demote("warm")
+        cache._assembled.clear(); cache.assembled_bytes = 0
+        cp_hot = cache.prefix_for(seg_hot)  # fresh build: hot, bf16-exact
+        cp_warm = cache.prefix_for(seg_warm)  # dequantized int8 history
+        assert cache.tier_stats()["tier_warm_entries"] == 2
+        paged_cfg = dataclasses.replace(
+            eng.engine_config, kv_paged=True, kv_block_size=16
+        )
+        cont = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=paged_cfg, dtypes=FP32
+        )
+
+        def drain(rid, fin):
+            outs = {}
+            while cont.has_active():
+                for r, toks in cont.step():
+                    outs[r] = toks
+            return fin if fin is not None else outs[rid]
+
+        # both tiers decode TOGETHER in one group of slots
+        _, fin1 = cont.admit_prefixed(1, suffix, cp_hot, max_new=6)
+        _, fin2 = cont.admit_prefixed(2, suffix, cp_warm, max_new=6)
+        outs = {}
+        while cont.has_active():
+            for r, toks in cont.step():
+                outs[r] = toks
+        got_hot = fin1 if fin1 is not None else outs[1]
+        got_warm = fin2 if fin2 is not None else outs[2]
+        assert got_warm is not None  # the warm row served
+        # the hot row's stream is byte-identical to a plain full admission
+        full_hot = [t for _, seg in seg_hot for t in seg] + suffix
+        _, fin3 = cont.admit(3, full_hot, max_new=6)
+        want_hot = drain(3, fin3)
+        assert got_hot == want_hot
+        # every row retired its blocks — only the two chains' registered
+        # full prefix blocks (cache refs) remain (no group-mixing leak)
+        registered = (cp_hot.length // 16) + (cp_warm.length // 16)
+        assert cont.kv_pool.blocks_in_use() == registered
+
+
+# ---------------------------------------------------------------------------
+# pool-side tier accounting
+# ---------------------------------------------------------------------------
+
+
+class TestPoolTiering:
+    @pytest.fixture()
+    def paged(self, tiny):
+        cfg, params = tiny
+        ec = EngineConfig(
+            prompt_buckets=(64,), max_batch_size=2, speculative="off",
+            max_seq_len=128, prefix_cache=PC, kv_paged=True,
+            kv_block_size=16, kv_pool_blocks=24,
+        )
+        return cfg, params, ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=ec, dtypes=FP32
+        )
+
+    def _prefix(self, cfg, params, tag="pool"):
+        eng = _engine(cfg, params, tiering=TIERING)
+        rng = np.random.default_rng(13)
+        segs = _segments(cfg, rng, tag)
+        return eng, eng.prefix_cache.prefix_for(segs)
+
+    def test_registration_tier_accounting_and_reclaimable(self, paged, tiny):
+        cfg, params, cont = paged
+        _, cp = self._prefix(cfg, params)
+        assert cont.prestage_prefix(cp, tier="warm") == "registered"
+        occ = cont.tier_occupancy()
+        assert occ["warm"] == cp.length // cont.block_size
+        assert cont.reclaimable_blocks() == occ["warm"]
+        # warm → hot: no longer reclaimable
+        assert cont.set_prefix_tier(cp.chain_key, "hot")
+        assert cont.reclaimable_blocks() == 0
+        assert cont.tier_occupancy()["hot"] > 0
+        # hot → cold DROPS the registration (pool-side spill)
+        free_before = cont.kv_pool.available()
+        assert cont.set_prefix_tier(cp.chain_key, "cold")
+        assert cont.kv_pool.available() == free_before + occ["warm"]
+        assert sum(
+            v for k, v in cont.tier_occupancy().items() if k != "rows"
+        ) == 0
+
+    def test_admission_reclaims_warm_registration_while_active(self, paged, tiny):
+        """A live row decoding + a warm registration crowding the pool:
+        admission_state reclaims the WARM registration instead of
+        reporting 'wait' — tier occupancy, not raw headroom."""
+        cfg, params, cont = paged
+        _, cp = self._prefix(cfg, params)
+        cont.admit(1, [5] * 40, max_new=4)  # 3 blocks + growth, stays active
+        assert cont.prestage_prefix(cp, tier="warm") == "registered"
+        # eat the remaining headroom so the next admission can't fit
+        # without the registration's block coming back
+        filler = cont.kv_pool.alloc(cont.kv_pool.available() - 2)
+        assert cont.has_active()
+        state = cont.admission_state(30)  # needs 2 blocks + headroom
+        assert state == "ok"  # the warm registration was reclaimed
+        assert cont.reclaimable_blocks() == 0
+        cont.kv_pool.free(filler)
+        cont.evict_requests([1])
+
+    def test_prestage_swap_in_fault_leaks_zero_blocks(self, paged, tiny):
+        cfg, params, cont = paged
+        _, cp = self._prefix(cfg, params)
+        free = cont.kv_pool.available()
+        faults.arm("kv_swap_in", times=1)
+        try:
+            assert cont.prestage_prefix(cp) is False
+        finally:
+            faults.clear()
+        assert cont.kv_pool.available() == free  # zero leaked blocks
+        # and the next prestage (fault cleared) succeeds
+        assert cont.prestage_prefix(cp) == "registered"
+        cont.release_prestaged(cp.chain_key)
+        assert cont.kv_pool.available() == free
+
+    def test_reset_clears_tier_ledgers(self, paged, tiny):
+        cfg, params, cont = paged
+        _, cp = self._prefix(cfg, params)
+        assert cont.prestage_prefix(cp, tier="warm") == "registered"
+        assert cont.reclaimable_blocks() > 0
+        cont.reset()
+        assert cont.reclaimable_blocks() == 0
+        occ = cont.tier_occupancy()
+        assert occ["hot"] == occ["warm"] == occ["rows"] == 0
+        assert cont.kv_pool.available() == cont.kv_pool.usable_blocks()
+
+
+class TestAdmissionTierHint:
+    def test_saturated_pool_with_reclaimable_blocks_queues_not_sheds(self):
+        from rag_llm_k8s_tpu.resilience.admission import (
+            AdmissionController,
+            AdmissionRejected,
+        )
+
+        gate = AdmissionController(max_concurrency=1, max_queue=4)
+        gate.saturation_hint = lambda: True
+        gate.reclaimable_hint = lambda: 0
+        holder = gate.admit()
+        holder.__enter__()
+        with pytest.raises(AdmissionRejected) as ei:
+            with gate.admit():
+                pass
+        assert ei.value.reason == "pool_exhausted"
+        # with reclaimable warmth the request QUEUES instead
+        gate.reclaimable_hint = lambda: 3
+        got = []
+
+        def second():
+            with gate.admit():
+                got.append(True)
+
+        t = threading.Thread(target=second)
+        t.start()
+        import time as _time
+
+        _time.sleep(0.1)
+        assert not got  # queued, not rejected
+        holder.__exit__(None, None, None)
+        t.join(timeout=5)
+        assert got == [True]

@@ -16,6 +16,7 @@ from rag_llm_k8s_tpu.core.config import (
     DTypePolicy,
     EncoderConfig,
     EngineConfig,
+    KVTieringConfig,
     LlamaConfig,
     LookaheadConfig,
     PrefixCacheConfig,
@@ -758,3 +759,65 @@ class TestLookaheadChaos:
         assert cont.kv_pool.blocks_in_use() == blocks0
         assert cache.release_staged(record) == 0
         assert cont.release_prestaged(cp.chain_key) is False
+
+
+class TestKvSwapInChaos:
+    def test_failed_swap_in_recomputes_and_leaks_nothing(self, tiny):
+        """Armed ``kv_swap_in`` (ISSUE 8 chaos contract): a cold chunk
+        whose host→HBM swap fails is rebuilt FROM TOKENS — the request
+        serves the identical greedy stream — its host buffer releases with
+        the failed entry, and the paged prestage path frees every block it
+        took before declining. Zero leaks on both substrates."""
+        import dataclasses
+
+        cfg, params, _ = tiny
+        pc = PrefixCacheConfig(
+            enabled=True, max_prefix_tokens=48, segment_buckets=(16,),
+            suffix_buckets=(16,), hbm_budget_mb=64,
+        )
+        tiering = KVTieringConfig(enabled=True, retier_interval_s=3600.0)
+        ie = InferenceEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=EngineConfig(
+                prompt_buckets=(64,), max_batch_size=2, max_seq_len=128,
+                prefix_cache=pc, kv_tiering=tiering,
+            ),
+            dtypes=FP32,
+        )
+        cache = ie.prefix_cache
+        segments = [
+            ("head:swap", [cfg.bos_token_id] + [7] * 15),
+            ("chunk:swap", [9] * 16),
+        ]
+        suffix = [5, 6, 7]
+        cp = cache.prefix_for(segments)
+        want = ie.generate_prefixed(suffix, cp)
+        assert cache.force_demote("cold") == 2
+        cache._assembled.clear()
+        cache.assembled_bytes = 0
+        faults.arm("kv_swap_in", times=2)  # BOTH segments' swaps fail
+        cp2 = cache.prefix_for(segments)
+        assert faults.armed() == {}, "kv_swap_in never fired"
+        assert cp2 is not None and cp2.computed_tokens == cp.length
+        assert len(cache.spill) == 0  # host buffers released
+        assert cache.tier_stats()["swap_in_fallbacks"] == 2
+        assert ie.generate_prefixed(suffix, cp2) == want
+
+        # paged pool substrate: the prestage swap-in fault frees the
+        # blocks it allocated and declines — no reset, no leak
+        cont = ContinuousEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=dataclasses.replace(
+                ie.engine_config, kv_paged=True, kv_block_size=16
+            ),
+            dtypes=FP32,
+        )
+        free0 = cont.kv_pool.available()
+        faults.arm("kv_swap_in", times=1)
+        assert cont.prestage_prefix(cp2) is False
+        assert faults.armed() == {}, "paged kv_swap_in never fired"
+        assert cont.kv_pool.available() == free0
+        # fault cleared: the identical prestage succeeds and releases clean
+        assert cont.prestage_prefix(cp2) == "registered"
+        assert cont.release_prestaged(cp2.chain_key) is True
+        assert cont.kv_pool.available() == free0
