@@ -645,6 +645,61 @@ class ServerConfig:
     embedder_path: str = "/models/bge-m3"
 
 
+@dataclass(frozen=True)
+class SloConfig:
+    """Burn-rate SLO objectives/thresholds (obs/slo.py::default_specs).
+
+    Parsing is SAFE BY CONTRACT: these knobs are consumed on the scrape /
+    ``GET /slo`` evaluation path, so a malformed or out-of-range env value
+    falls back to the field default instead of raising — a typo'd
+    objective must degrade a dashboard number, never 500 ``/metrics``.
+    (Objectives must land strictly inside (0, 1) and latency thresholds
+    strictly above 0 or ``SloSpec.__post_init__`` would reject them at
+    evaluation time — exactly the failure mode this parse prevents.)
+    """
+
+    # fraction of requests that must be non-5xx
+    # (env TPU_RAG_SLO_AVAILABILITY_OBJECTIVE)
+    availability_objective: float = 0.999
+    # end-to-end request latency SLO: objective fraction under threshold_s
+    # (env TPU_RAG_SLO_REQUEST_P95_OBJECTIVE / TPU_RAG_SLO_REQUEST_P95_S)
+    request_p95_objective: float = 0.95
+    request_p95_s: float = 2.0
+    # time-to-first-token SLO, continuous serving
+    # (env TPU_RAG_SLO_TTFT_P95_OBJECTIVE / TPU_RAG_SLO_TTFT_P95_S)
+    ttft_p95_objective: float = 0.95
+    ttft_p95_s: float = 1.0
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "SloConfig":
+        env = dict(os.environ if env is None else env)
+
+        def _f(var: str, dflt: float, lo: float, hi: float) -> float:
+            raw = env.get(var)
+            if raw is None:
+                return dflt
+            try:
+                v = float(raw)
+            except (TypeError, ValueError):
+                return dflt
+            return v if lo < v < hi else dflt
+
+        inf = float("inf")
+        return cls(
+            availability_objective=_f(
+                "TPU_RAG_SLO_AVAILABILITY_OBJECTIVE", 0.999, 0.0, 1.0
+            ),
+            request_p95_objective=_f(
+                "TPU_RAG_SLO_REQUEST_P95_OBJECTIVE", 0.95, 0.0, 1.0
+            ),
+            request_p95_s=_f("TPU_RAG_SLO_REQUEST_P95_S", 2.0, 0.0, inf),
+            ttft_p95_objective=_f(
+                "TPU_RAG_SLO_TTFT_P95_OBJECTIVE", 0.95, 0.0, 1.0
+            ),
+            ttft_p95_s=_f("TPU_RAG_SLO_TTFT_P95_S", 1.0, 0.0, inf),
+        )
+
+
 # ---------------------------------------------------------------------------
 # top-level
 # ---------------------------------------------------------------------------
@@ -671,6 +726,7 @@ class AppConfig:
     server: ServerConfig = field(default_factory=ServerConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     lookahead: LookaheadConfig = field(default_factory=LookaheadConfig)
+    slo: SloConfig = field(default_factory=SloConfig)
     system_message: str = SYSTEM_MESSAGE
 
     @classmethod
@@ -901,4 +957,5 @@ class AppConfig:
         return dataclasses.replace(
             cfg, server=server, mesh=mesh, sampling=sampling, engine=engine,
             resilience=resilience, lookahead=lookahead,
+            slo=SloConfig.from_env(env),
         )

@@ -848,17 +848,24 @@ class InferenceEngine:
     def prefix_buffer_zero(self):
         """The shared all-zeros ``[L, 1, K, P, hd]`` splice buffer every
         prefix assembly starts from (immutable — splices produce new
-        buffers, so one instance serves all threads)."""
+        buffers, so one instance serves all threads). Built OUTSIDE the
+        lock: the multi-MiB device transfer must not serialize concurrent
+        resolves behind first-touch init (two racing builders waste one
+        allocation of an immutable buffer; first install wins)."""
+        with self._lock:
+            cached = self._prefix_zero
+        if cached is not None:
+            return cached
+        planes = tuple(
+            jnp.zeros(s, d)
+            for s, d in self._prefix_plane_shapes(self._prefix_capacity())
+        )
+        if self.mesh is not None:
+            planes = tuple(
+                jax.device_put(p, self.mesh.replicated) for p in planes
+            )
         with self._lock:
             if self._prefix_zero is None:
-                planes = tuple(
-                    jnp.zeros(s, d)
-                    for s, d in self._prefix_plane_shapes(self._prefix_capacity())
-                )
-                if self.mesh is not None:
-                    planes = tuple(
-                        jax.device_put(p, self.mesh.replicated) for p in planes
-                    )
                 self._prefix_zero = planes
             return self._prefix_zero
 

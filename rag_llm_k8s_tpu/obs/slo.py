@@ -36,13 +36,13 @@ microseconds against hand-computed burn fixtures.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from bisect import bisect_left
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from rag_llm_k8s_tpu.core.config import SloConfig
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
 
 __all__ = ["SloSpec", "SloEngine", "BurnPolicy", "default_specs"]
@@ -95,8 +95,10 @@ class SloSpec:
             raise ValueError("latency SLO needs threshold_s")
 
 
-def default_specs() -> List[SloSpec]:
-    """The served defaults (env-overridable thresholds/objectives):
+def default_specs(cfg: Optional[SloConfig] = None) -> List[SloSpec]:
+    """The served defaults (knobs on ``core/config.py::SloConfig`` — env
+    ``TPU_RAG_SLO_*``, parsed there with safe fallbacks so a malformed
+    value retunes to the default instead of raising at scrape time):
 
     - availability 99.9% of requests non-5xx;
     - request p95 < 2 s (the BASELINE.md north-star budget applied at p95 —
@@ -104,22 +106,17 @@ def default_specs() -> List[SloSpec]:
     - TTFT p95 < 1 s (meaningful under continuous serving, where TTFT is
       measured exactly; vacuously compliant when the histogram is empty).
     """
-
-    def _f(env: str, dflt: float) -> float:
-        try:
-            return float(os.environ.get(env, dflt))
-        except ValueError:
-            return dflt
-
+    if cfg is None:
+        cfg = SloConfig.from_env()
     return [
         SloSpec("availability", "availability", "rag_http_requests_total",
-                objective=_f("TPU_RAG_SLO_AVAILABILITY_OBJECTIVE", 0.999)),
+                objective=cfg.availability_objective),
         SloSpec("request_p95", "latency", "rag_request_duration_seconds",
-                objective=_f("TPU_RAG_SLO_REQUEST_P95_OBJECTIVE", 0.95),
-                threshold_s=_f("TPU_RAG_SLO_REQUEST_P95_S", 2.0)),
+                objective=cfg.request_p95_objective,
+                threshold_s=cfg.request_p95_s),
         SloSpec("ttft_p95", "latency", "rag_time_to_first_token_seconds",
-                objective=_f("TPU_RAG_SLO_TTFT_P95_OBJECTIVE", 0.95),
-                threshold_s=_f("TPU_RAG_SLO_TTFT_P95_S", 1.0)),
+                objective=cfg.ttft_p95_objective,
+                threshold_s=cfg.ttft_p95_s),
     ]
 
 

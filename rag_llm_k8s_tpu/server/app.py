@@ -543,7 +543,10 @@ class RagService:
         # the decision layer: SLO specs evaluated over sliding windows of
         # the histograms/counters registered above; exports rag_slo_* gauges
         # into the same registry and backs GET /slo (obs/slo.py)
-        self.slo = obs_slo.SloEngine(reg)
+        self.slo = obs_slo.SloEngine(
+            reg,
+            specs=obs_slo.default_specs(getattr(self.config, "slo", None)),
+        )
 
     def _engines(self) -> Dict[int, object]:
         """The serving engines, deduped by identity (see the summing note

@@ -1,81 +1,49 @@
 #!/usr/bin/env python
-"""Lint gate: every metric name registered in code is documented.
+"""Thin shim: the metrics↔docs gate moved into ragcheck (PR 10).
 
-Scans the package (and bench.py) for metric registrations — registry
-``counter/gauge/histogram/labeled_*`` calls and the legacy facade's
-``inc``/``observe`` string literals — and fails if any discovered name is
-missing from the docs/OBSERVABILITY.md table. Run by ``make lint``.
-
-Zero third-party dependencies on purpose: this must run in any
-environment the tier-1 gate runs in.
+The source-scanning logic that lived here is now ragcheck's METRIC-DRIFT
+rule (scripts/ragcheck/rules/metric_drift.py), which also checks label-set
+consistency and label-value cardinality. This shim keeps ``make lint`` and
+any scripted invocation of the old path working by running just that rule;
+``make analyze`` runs the full suite. Zero third-party dependencies, as
+before: this must run in any environment the tier-1 gate runs in.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-# registry registrations + the legacy facade's literal counter names
-_REGISTER_RE = re.compile(
-    r"\.(?:counter|gauge|histogram|labeled_histogram|labeled_counter|"
-    r"labeled_gauge)\(\s*"
-    r"['\"]([A-Za-z_][A-Za-z0-9_]*)['\"]"
-)
-_FACADE_RE = re.compile(
-    r"\.(?:inc|observe)\(\s*['\"]([A-Za-z_][A-Za-z0-9_]*)['\"]"
-)
+from scripts.ragcheck.core import gate, load_baseline, run_analysis  # noqa: E402
+from scripts.ragcheck.rules.metric_drift import MetricDriftRule  # noqa: E402
 
-
-def scan_sources() -> dict:
-    """{metric_name: first 'path:line' registering it}."""
-    roots = [os.path.join(REPO, "rag_llm_k8s_tpu"), os.path.join(REPO, "bench.py")]
-    found: dict = {}
-    files = []
-    for root in roots:
-        if os.path.isfile(root):
-            files.append(root)
-            continue
-        for dirpath, _, names in os.walk(root):
-            files.extend(
-                os.path.join(dirpath, n) for n in names if n.endswith(".py")
-            )
-    for path in sorted(files):
-        with open(path, encoding="utf-8") as f:
-            text = f.read()
-        rel = os.path.relpath(path, REPO)
-        for rx in (_REGISTER_RE, _FACADE_RE):
-            for m in rx.finditer(text):  # \s* spans newlines: multi-line calls
-                lineno = text.count("\n", 0, m.start()) + 1
-                found.setdefault(m.group(1), f"{rel}:{lineno}")
-    return found
+BASELINE = os.path.join(REPO, "scripts", "ragcheck", "baseline.json")
 
 
 def main() -> int:
-    if not os.path.exists(DOC):
-        print(f"check_metrics_docs: missing {DOC}", file=sys.stderr)
+    # baseline-aware, same as `make analyze`: a justified baselined
+    # METRIC-DRIFT entry must not turn `make lint` red inside the same CI
+    # run that declared it accepted (stale entries of THIS rule still fail
+    # here — the ratchet is rule-agnostic)
+    _, findings = run_analysis(REPO, rules=[MetricDriftRule()])
+    baseline = load_baseline(BASELINE)
+    new, stale = gate(findings, baseline)
+    stale = [fp for fp in stale if fp.startswith(f"{MetricDriftRule.id}::")]
+    if new or stale:
+        print(
+            "check_metrics_docs (now ragcheck METRIC-DRIFT) failed:",
+            file=sys.stderr,
+        )
+        for f in new:
+            print(f"  {f.render()}", file=sys.stderr)
+        for fp in stale:
+            print(f"  stale baseline entry: {fp}", file=sys.stderr)
         return 1
-    with open(DOC, encoding="utf-8") as f:
-        doc = f.read()
-    found = scan_sources()
-    if not found:
-        print("check_metrics_docs: no metric registrations found — "
-              "the scanner regexes are broken", file=sys.stderr)
-        return 1
-    missing = {
-        name: site for name, site in sorted(found.items())
-        if f"`{name}`" not in doc and name not in doc
-    }
-    if missing:
-        print("check_metrics_docs: metric names registered in code but "
-              "absent from docs/OBSERVABILITY.md:", file=sys.stderr)
-        for name, site in missing.items():
-            print(f"  {name}  (registered at {site})", file=sys.stderr)
-        return 1
-    print(f"check_metrics_docs: OK ({len(found)} metric names documented)")
+    print("check_metrics_docs: OK (ragcheck METRIC-DRIFT clean)")
     return 0
 
 

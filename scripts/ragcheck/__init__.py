@@ -1,0 +1,28 @@
+"""ragcheck — the repo-native static-analysis suite (``make analyze``).
+
+The serving stack has a handful of correctness disciplines that reviews
+kept re-finding as bugs: no blocking device work under the cache lock, no
+host calls inside traced functions, ``out_shardings`` pinned on every
+executable that round-trips arena/cache state, every ``TPU_RAG_*`` knob
+routed through ``core/config.py`` and pinned in deploy.yaml + the RUNBOOK,
+a closed fault-site catalog with test coverage, and a metrics surface that
+matches its documentation. ragcheck mechanizes those disciplines as
+deterministic AST rules so ``make ci`` catches the violation, not the
+reviewer three PRs later.
+
+Stdlib-only on purpose: this runs everywhere the tier-1 gate runs.
+
+See docs/STATIC_ANALYSIS.md for the rule catalog, the inline-suppression
+syntax (``# ragcheck: disable=RULE-ID``), and the baseline-ratchet
+workflow (scripts/ragcheck/baseline.json may only shrink).
+"""
+
+from scripts.ragcheck.core import (  # noqa: F401
+    Finding,
+    Repo,
+    gate,
+    load_baseline,
+    run_analysis,
+)
+
+__all__ = ["Finding", "Repo", "gate", "load_baseline", "run_analysis"]

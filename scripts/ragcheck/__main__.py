@@ -1,0 +1,94 @@
+"""CLI: ``python -m scripts.ragcheck`` (what ``make analyze`` runs).
+
+Exit codes: 0 clean (every finding baselined), 1 new findings or a stale
+baseline entry (the ratchet), 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from scripts.ragcheck.core import gate, load_baseline, run_analysis
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ragcheck",
+        description="repo-native static analysis (docs/STATIC_ANALYSIS.md)",
+    )
+    ap.add_argument(
+        "--root",
+        default=os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        help="repo root to analyze (default: this checkout)",
+    )
+    ap.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="baseline JSON (default: scripts/ragcheck/baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, baselined or not (exit 1 if any)",
+    )
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    try:
+        _, findings = run_analysis(args.root)
+        baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    except ValueError as e:
+        print(f"ragcheck: {e}", file=sys.stderr)
+        return 2
+    new, stale = gate(findings, baseline)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_json() for f in findings],
+                    "new": [f.fingerprint for f in new],
+                    "stale_baseline": stale,
+                    "baselined": len(findings) - len(new),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        for fp in stale:
+            print(
+                f"stale baseline entry (finding no longer fires): {fp} — "
+                "delete it from the baseline (the ratchet only shrinks)"
+            )
+        n_base = len(findings) - len(new)
+        if new or stale:
+            print(
+                f"ragcheck: {len(new)} new finding(s), {len(stale)} stale "
+                f"baseline entr{'y' if len(stale) == 1 else 'ies'} "
+                f"({n_base} baselined). Fix the findings, suppress a true "
+                "false-positive inline with `# ragcheck: disable=RULE-ID`, "
+                "or baseline with a justification "
+                "(docs/STATIC_ANALYSIS.md).",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"ragcheck: OK ({len(findings)} finding(s), all baselined "
+                f"with justification)" if findings
+                else "ragcheck: OK (no findings)"
+            )
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+"""Rule registry: one module per rule, ids match docs/STATIC_ANALYSIS.md."""
+
+from scripts.ragcheck.rules.lock_discipline import LockDisciplineRule
+from scripts.ragcheck.rules.jit_hygiene import JitHygieneRule
+from scripts.ragcheck.rules.sharding_contract import ShardingContractRule
+from scripts.ragcheck.rules.config_drift import ConfigDriftRule
+from scripts.ragcheck.rules.fault_sites import FaultSiteRegistryRule
+from scripts.ragcheck.rules.metric_drift import MetricDriftRule
+
+ALL_RULES = [
+    LockDisciplineRule,
+    JitHygieneRule,
+    ShardingContractRule,
+    ConfigDriftRule,
+    FaultSiteRegistryRule,
+    MetricDriftRule,
+]
+
+__all__ = ["ALL_RULES"]

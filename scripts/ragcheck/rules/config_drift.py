@@ -1,0 +1,177 @@
+"""CONFIG-DRIFT: env knobs live in core/config.py and stay pinned in deploy.
+
+Two sub-checks, one discipline — configuration has exactly one home and
+two mirrors:
+
+1. **env-read placement**: any ``os.environ`` / ``os.getenv`` reference in
+   the package outside ``core/config.py`` is drift (the ``TPU_RAG_SLO_*``
+   knobs hid in ``obs/slo.py`` for three PRs and one malformed value away
+   from a scrape-time ValueError). ``server/main.py`` is the bootstrap
+   allowlist: logging must configure before ``AppConfig`` can exist.
+2. **knob pinning**: every ``TPU_RAG_*`` knob named in ``core/config.py``
+   must appear in ``deploy/llm/deploy.yaml`` (a knob you cannot see in the
+   manifest is a knob production is not running) and in the RUNBOOK's
+   §"Configuration reference" table (an operator paged at 3am reads the
+   table, not ``from_env``).
+
+Knob extraction is AST-literal based: string constants matching
+``TPU_RAG_[A-Z0-9_]+`` exactly (docstrings mention knobs inside prose and
+never as exact-match literals, so they don't count).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Set
+
+from scripts.ragcheck.core import Finding, QualnameVisitor, Repo, dotted_name
+
+CONFIG_HOME = "rag_llm_k8s_tpu/core/config.py"
+#: bootstrap allowlist: files that may read the environment directly
+#: (process setup that runs before a config object can exist)
+ENV_READ_ALLOWLIST = ("rag_llm_k8s_tpu/server/main.py",)
+
+DEPLOY_MANIFEST = "deploy/llm/deploy.yaml"
+RUNBOOK = "docs/RUNBOOK.md"
+_RUNBOOK_SECTION = "Configuration reference"
+
+_KNOB = re.compile(r"^TPU_RAG_[A-Z0-9_]+$")
+
+
+class _EnvReadVisitor(QualnameVisitor):
+    def __init__(self):
+        super().__init__()
+        self.sites: List = []  # (qualname, lineno, what)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if dotted_name(node) == "os.environ":
+            self.sites.append((self.qualname, node.lineno, "os.environ"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        if dotted_name(node.func) == "os.getenv":
+            self.sites.append((self.qualname, node.lineno, "os.getenv"))
+        self.generic_visit(node)
+
+
+def _config_knobs(repo: Repo) -> List[tuple]:
+    sf = repo.get(CONFIG_HOME)
+    if sf is None or sf.tree is None:
+        return []
+    knobs = {}
+    for node in ast.walk(sf.tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and _KNOB.match(node.value)
+        ):
+            knobs.setdefault(node.value, node.lineno)
+    return sorted(knobs.items())
+
+
+def _runbook_config_table(text: str) -> str:
+    """The configuration-reference SECTION only (matched as a markdown
+    heading — the table of contents also names it): presence elsewhere in
+    the RUNBOOK (a troubleshooting aside) is not documentation of the
+    knob."""
+    m = re.search(
+        rf"^#+ .*{re.escape(_RUNBOOK_SECTION)}.*$", text, re.MULTILINE
+    )
+    if m is None:
+        return ""
+    rest = text[m.end():]
+    nxt = re.search(r"^## ", rest, re.MULTILINE)
+    return rest if nxt is None else rest[: nxt.start()]
+
+
+class ConfigDriftRule:
+    id = "CONFIG-DRIFT"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        # 1. env-read placement
+        for sf in repo.scan_files:
+            if sf.tree is None or not sf.path.startswith("rag_llm_k8s_tpu/"):
+                continue
+            if sf.path == CONFIG_HOME or sf.path in ENV_READ_ALLOWLIST:
+                continue
+            v = _EnvReadVisitor()
+            v.visit(sf.tree)
+            seen: Set[str] = set()
+            for qual, lineno, what in v.sites:
+                key = f"env-read:{qual}"
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Finding(
+                    rule=self.id,
+                    path=sf.path,
+                    line=lineno,
+                    message=(
+                        f"{what} read in {qual} — every env knob is parsed "
+                        "once, safely, in core/config.py; thread the value "
+                        "through a config object instead"
+                    ),
+                    key=key,
+                )
+
+        # 2. knob pinning in deploy.yaml + RUNBOOK config-reference table
+        knobs = _config_knobs(repo)
+        if not knobs:
+            return
+        deploy = repo.text(DEPLOY_MANIFEST)
+        runbook = repo.text(RUNBOOK)
+        table = _runbook_config_table(runbook) if runbook is not None else None
+        # a tree that DEFINES knobs but has no manifest / no config-reference
+        # section is the same scanner-rot class METRIC-DRIFT guards against:
+        # renaming deploy.yaml must not silently retire the whole gate
+        if deploy is None:
+            yield Finding(
+                rule=self.id, path=DEPLOY_MANIFEST, line=1,
+                message=(
+                    f"{DEPLOY_MANIFEST} is missing but core/config.py "
+                    "defines knobs — the pinning gate has nothing to check "
+                    "(manifest moved? update ragcheck's DEPLOY_MANIFEST)"
+                ),
+                key="missing-deploy-manifest",
+            )
+        if table is None or not table.strip():
+            yield Finding(
+                rule=self.id, path=RUNBOOK, line=1,
+                message=(
+                    f"{RUNBOOK} has no '{_RUNBOOK_SECTION}' section but "
+                    "core/config.py defines knobs — the documentation gate "
+                    "has nothing to check"
+                ),
+                key="missing-runbook-config-section",
+            )
+            table = None
+        for name, lineno in knobs:
+            # word-bounded: TPU_RAG_KV_TIERING must not read as pinned just
+            # because TPU_RAG_KV_TIERING_WARM_BELOW is ('_' is a word char,
+            # so \b rejects the prefix-of-a-longer-knob match)
+            if deploy is not None and not re.search(
+                rf"\b{re.escape(name)}\b", deploy
+            ):
+                yield Finding(
+                    rule=self.id,
+                    path=DEPLOY_MANIFEST,
+                    line=1,
+                    message=(
+                        f"config knob {name} (core/config.py:{lineno}) is "
+                        "not pinned in the deployment manifest — production "
+                        "must state every knob it runs, even at the default"
+                    ),
+                    key=f"knob-deploy:{name}",
+                )
+            if table is not None and f"`{name}`" not in table:
+                yield Finding(
+                    rule=self.id,
+                    path=RUNBOOK,
+                    line=1,
+                    message=(
+                        f"config knob {name} (core/config.py:{lineno}) has "
+                        "no row in the RUNBOOK configuration-reference table"
+                    ),
+                    key=f"knob-runbook:{name}",
+                )

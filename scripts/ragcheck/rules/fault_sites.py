@@ -1,0 +1,117 @@
+"""FAULT-SITE-REGISTRY: the fault-site catalog is closed AND exercised.
+
+``resilience/faults.SITES`` is deliberately closed — a typo'd site name is
+a programming error, not a silently-never-firing fault. The runtime
+enforces that for *armed* names, but nothing enforced it for the
+``maybe_fail("...")`` call sites themselves (a typo there compiles fine
+and simply never fires, which is how a chaos lane rots), nor that each
+catalog entry is actually pulled by at least one test (an unexercised
+site is an untested recovery path wearing a tested one's name).
+
+Two sub-checks:
+
+1. every string literal passed to ``maybe_fail(...)`` / ``arm(...)`` (in
+   the package AND in tests/) is a member of ``SITES``;
+2. every ``SITES`` entry appears as a string literal somewhere in tests/.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from scripts.ragcheck.core import Finding, Repo, terminal_attr
+
+FAULTS_MODULE = "rag_llm_k8s_tpu/resilience/faults.py"
+_ARMING_CALLS = {"maybe_fail", "arm"}
+
+
+def _declared_sites(repo: Repo) -> Tuple[Optional[int], List[str]]:
+    sf = repo.get(FAULTS_MODULE)
+    if sf is None or sf.tree is None:
+        return None, []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "SITES":
+                vals = [
+                    e.value
+                    for e in ast.walk(node.value)
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                ]
+                return node.lineno, vals
+    return None, []
+
+
+def _site_literal(call: ast.Call) -> Optional[ast.Constant]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "site" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value
+    return None
+
+
+class FaultSiteRegistryRule:
+    id = "FAULT-SITE-REGISTRY"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        line, sites = _declared_sites(repo)
+        if line is None:
+            return  # no faults module in this tree (fixture repos)
+        site_set: Set[str] = set(sites)
+
+        test_files = repo.glob_py("tests")
+        scan = list(repo.scan_files) + test_files
+        for sf in scan:
+            if sf.tree is None or sf.path == FAULTS_MODULE:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                t = terminal_attr(node.func)
+                if t not in _ARMING_CALLS:
+                    continue
+                lit = _site_literal(node)
+                if lit is None or lit.value in site_set:
+                    continue
+                yield Finding(
+                    rule=self.id,
+                    path=sf.path,
+                    line=node.lineno,
+                    message=(
+                        f"{t}({lit.value!r}) names a site not in "
+                        f"resilience/faults.SITES — a typo'd site never "
+                        "fires; add it to the catalog or fix the name"
+                    ),
+                    key=f"unknown-site:{lit.value}",
+                )
+
+        # 2. every catalog entry is exercised by at least one test — as an
+        # EXACT string literal (AST constants): a docstring sentence that
+        # merely mentions the site must not count as exercising it
+        test_literals: Set[str] = set()
+        for sf in test_files:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str
+                ):
+                    test_literals.add(node.value)
+        for site in sites:
+            if site not in test_literals:
+                yield Finding(
+                    rule=self.id,
+                    path=FAULTS_MODULE,
+                    line=line,
+                    message=(
+                        f"fault site {site!r} is in SITES but no test names "
+                        "it — an unexercised site is an untested recovery "
+                        "path; arm it in a chaos test or retire the entry"
+                    ),
+                    key=f"untested-site:{site}",
+                )

@@ -1,0 +1,179 @@
+"""JIT-HYGIENE: no host-side effects or concretization in traced functions.
+
+A function handed to ``jax.jit``/``pjit``/``shard_map``/``pl.pallas_call``
+runs ONCE at trace time; host calls inside it silently bake a single value
+into the compiled program (``time.time()`` freezes the clock, ``random.*``
+freezes the "randomness") and concretizing a traced value
+(``float(x)``/``int(x)``/``bool(x)``/``.item()``) either raises a
+``TracerError`` at the first untested call or forces a device sync where
+one executable was expected. Both classes shipped to review repeatedly;
+both are mechanical to detect.
+
+Flagged inside a traced function (nested ``def``s included — ``cond``/
+``body`` closures run traced too):
+
+- calls into ``time.*``, ``random.*``, ``np.random.*`` / ``numpy.random.*``;
+- ``.item()`` anywhere;
+- ``float()``/``int()``/``bool()`` applied directly to one of the traced
+  function's PARAMETERS (static python values computed before the closure
+  are fine — only tracer concretization is the bug).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from scripts.ragcheck.core import Finding, Repo, ScopedDefIndex, dotted_name
+
+_TRACE_ENTRYPOINTS = {"jit", "pjit", "shard_map", "pallas_call"}
+_CONCRETIZERS = {"float", "int", "bool"}
+
+
+def _traced_args(call: ast.Call) -> List[ast.AST]:
+    """The function-valued argument(s) of a trace entry point."""
+    out: List[ast.AST] = []
+    if call.args:
+        out.append(call.args[0])
+    for kw in call.keywords:
+        if kw.arg in ("f", "fun", "func", "kernel"):
+            out.append(kw.value)
+    return out
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _scan_traced(
+    fn: ast.AST, fn_label: str, path: str, findings: List[Finding]
+) -> None:
+    params = _param_names(fn)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d is not None:
+                root2 = ".".join(d.split(".")[:2])
+                if (
+                    d.startswith(("time.", "random."))
+                    or root2 in ("np.random", "numpy.random")
+                ):
+                    findings.append(
+                        Finding(
+                            rule=JitHygieneRule.id,
+                            path=path,
+                            line=node.lineno,
+                            message=(
+                                f"host call {d}() inside traced function "
+                                f"{fn_label} — it executes once at trace "
+                                "time; pass the value in as an argument"
+                            ),
+                            key=f"{fn_label}:{d}",
+                        )
+                    )
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+                findings.append(
+                    Finding(
+                        rule=JitHygieneRule.id,
+                        path=path,
+                        line=node.lineno,
+                        message=(
+                            f".item() inside traced function {fn_label} — "
+                            "concretizing a tracer forces a device sync or "
+                            "a TracerError"
+                        ),
+                        key=f"{fn_label}:item",
+                    )
+                )
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _CONCRETIZERS
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in params
+            ):
+                findings.append(
+                    Finding(
+                        rule=JitHygieneRule.id,
+                        path=path,
+                        line=node.lineno,
+                        message=(
+                            f"{node.func.id}({node.args[0].id}) concretizes a "
+                            f"traced parameter of {fn_label} — use jnp casts "
+                            "or mark the argument static"
+                        ),
+                        key=f"{fn_label}:{node.func.id}:{node.args[0].id}",
+                    )
+                )
+
+
+def _is_trace_decorated(fn: ast.AST) -> bool:
+    """``@jax.jit`` / ``@pjit`` / ``@functools.partial(jax.jit, ...)`` —
+    the repo's dominant jit idiom (the ops/ kernel wrappers) traces the
+    decorated function exactly like the call form does."""
+    for dec in getattr(fn, "decorator_list", []):
+        d = dotted_name(dec)
+        if d is not None and d.split(".")[-1] in _TRACE_ENTRYPOINTS:
+            return True
+        if isinstance(dec, ast.Call):
+            dd = dotted_name(dec.func)
+            if dd is None:
+                continue
+            last = dd.split(".")[-1]
+            if last in _TRACE_ENTRYPOINTS:
+                return True
+            if last == "partial" and dec.args:
+                a0 = dotted_name(dec.args[0])
+                if a0 is not None and a0.split(".")[-1] in _TRACE_ENTRYPOINTS:
+                    return True
+    return False
+
+
+class JitHygieneRule:
+    id = "JIT-HYGIENE"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        for sf in repo.scan_files:
+            if sf.tree is None:
+                continue
+            index = ScopedDefIndex(sf.tree)
+            findings: List[Finding] = []
+            seen: Set[int] = set()  # id() of scanned fn nodes — scan once
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if _is_trace_decorated(node) and id(node) not in seen:
+                        seen.add(id(node))
+                        _scan_traced(
+                            node, index.qualname(node), sf.path, findings
+                        )
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                if d is None or d.split(".")[-1] not in _TRACE_ENTRYPOINTS:
+                    continue
+                for arg in _traced_args(node):
+                    targets: List[ast.AST] = []
+                    if isinstance(arg, ast.Lambda):
+                        targets = [arg]
+                    elif isinstance(arg, ast.Name):
+                        targets = index.resolve(node, arg.id)
+                    for fn in targets:
+                        if id(fn) in seen:
+                            continue
+                        seen.add(id(fn))
+                        # qualified label: two same-named defs in one file
+                        # must not share (and so dedupe/mask) fingerprints
+                        _scan_traced(
+                            fn, index.qualname(fn), sf.path, findings
+                        )
+            yield from findings

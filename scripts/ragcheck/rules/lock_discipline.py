@@ -1,0 +1,146 @@
+"""LOCK-DISCIPLINE: no blocking device/host work inside a lock body.
+
+The PR 7/8 hardening class: every cache/pool lock in this repo guards
+nothing but host bookkeeping, and every time a device transfer, a compiled
+executable, a sleep, or a thread join crept under one, it serialized every
+concurrent resolve (or deadlocked a drain) until a reviewer caught it.
+Canonical fixes on file: ``PrefixCache._swap_in`` runs its ``device_put``
+unlocked and installs under a stamp-guarded re-acquire; the retier sweep's
+cold-spill D2H copies run off-lock with a plane-identity install guard.
+
+Flagged inside any ``with <...>_lock:`` body (nested ``def``/``lambda``
+bodies are deferred execution, not lock-held, and are skipped):
+
+- ``jax.device_put`` / ``.block_until_ready()`` — device transfers/syncs;
+- ``time.sleep`` — never hold a lock to wait;
+- thread joins (``x.join(timeout=...)`` or a receiver named like a
+  thread/worker/sweeper) — a join under the lock the worker needs is a
+  deadlock with extra steps;
+- coalescer/executor/scheduler ``submit()`` — blocks until a whole batch
+  window dispatches;
+- compiled-executable work: invoking a ``_compiled[...]`` entry, calling a
+  ``_build_*`` executable builder, or running a ``jax.jit(...)...
+  .lower(...).compile()`` chain — compiles and device programs take
+  arbitrarily long and must never be timed under a lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from scripts.ragcheck.core import (
+    Finding,
+    QualnameVisitor,
+    Repo,
+    dotted_name,
+    receiver_of,
+    terminal_attr,
+)
+
+_LOCK_NAME = re.compile(r"(^|_)lock$")
+_THREADISH = re.compile(r"(thread|worker|sweeper)", re.IGNORECASE)
+_SUBMITTISH = re.compile(r"(coalescer|executor|scheduler|pool)", re.IGNORECASE)
+
+
+def _is_lock_ctx(expr: ast.AST) -> bool:
+    t = terminal_attr(expr)
+    return t is not None and bool(_LOCK_NAME.search(t))
+
+
+def _chain_has_jit(node: ast.AST) -> bool:
+    """True when an attribute/call chain bottoms out at jax.jit/pjit
+    (``jax.jit(f).lower(...).compile()``)."""
+    while True:
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d is not None and d.split(".")[-1] in ("jit", "pjit"):
+                return True
+            node = node.func
+        elif isinstance(node, ast.Attribute):
+            node = node.value
+        else:
+            return False
+
+
+def _offense(call: ast.Call) -> str | None:
+    """The violation label for a call, or None when it is allowed."""
+    func = call.func
+    t = terminal_attr(func)
+    d = dotted_name(func)
+    if t == "device_put":
+        return "device_put"
+    if t == "block_until_ready":
+        return "block_until_ready"
+    if d == "time.sleep":
+        return "time.sleep"
+    if t == "join":
+        recv = receiver_of(func)
+        rname = terminal_attr(recv) if recv is not None else None
+        has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+        if has_timeout or (rname and _THREADISH.search(rname)):
+            return "thread-join"
+    if t == "submit":
+        recv = receiver_of(func)
+        rname = terminal_attr(recv) if recv is not None else None
+        if rname and _SUBMITTISH.search(rname):
+            return "submit"
+    if t and t.startswith("_build_"):
+        return f"executable-build:{t}"
+    if isinstance(func, ast.Subscript):
+        sub = terminal_attr(func.value)
+        if sub and "compiled" in sub:
+            return "compiled-executable-call"
+    if t in ("lower", "compile") and _chain_has_jit(func):
+        return "jit-lower-compile"
+    return None
+
+
+class _Visitor(QualnameVisitor):
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self.findings: List[Finding] = []
+
+    def visit_With(self, node: ast.With):
+        lock_items = [i for i in node.items if _is_lock_ctx(i.context_expr)]
+        if lock_items:
+            lock = terminal_attr(lock_items[0].context_expr)
+            for stmt in node.body:
+                self._scan_locked(stmt, lock)
+        self.generic_visit(node)
+
+    def _scan_locked(self, node: ast.AST, lock: str):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # deferred execution — not run under the lock
+        if isinstance(node, ast.Call):
+            off = _offense(node)
+            if off is not None:
+                self.findings.append(
+                    Finding(
+                        rule=LockDisciplineRule.id,
+                        path=self.path,
+                        line=node.lineno,
+                        message=(
+                            f"{off} inside `with {lock}:` in {self.qualname} — "
+                            "move the blocking work outside the lock and "
+                            "install the result under a short re-acquire"
+                        ),
+                        key=f"{self.qualname}:{off}",
+                    )
+                )
+        for child in ast.iter_child_nodes(node):
+            self._scan_locked(child, lock)
+
+
+class LockDisciplineRule:
+    id = "LOCK-DISCIPLINE"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        for sf in repo.scan_files:
+            if sf.tree is None:
+                continue
+            v = _Visitor(sf.path)
+            v.visit(sf.tree)
+            yield from v.findings

@@ -1,0 +1,224 @@
+"""METRIC-DRIFT: the metrics surface matches its docs and its own shape.
+
+Absorbs ``scripts/check_metrics_docs.py`` (PR 2's lint gate) as sub-check
+1 and adds the label-set discipline PRs 6-8 were hand-reviewing:
+
+1. **docs**: every metric name registered in code — registry
+   ``counter/gauge/histogram/labeled_*`` calls and the legacy facade's
+   ``inc``/``observe`` string literals — appears in
+   ``docs/OBSERVABILITY.md``. An undocumented family is invisible to the
+   operator the RUNBOOK sends to the table.
+2. **label-set consistency**: a family is always emitted with the same
+   label NAMES. Prometheus treats ``f{stage=...}`` and ``f{phase=...}``
+   as disjoint series under one name — every aggregation over the family
+   silently splits.
+3. **no dynamically-formatted label values**: an f-string / ``%`` /
+   ``.format()`` label value is an unbounded-cardinality time series
+   waiting for traffic. Pass a bounded literal (or ``str(code)`` over a
+   bounded domain) instead.
+
+Resolution is intra-file and deliberately simple: a ``.labels(...)`` /
+``.labels_callback(...)`` call maps to a family when chained directly on a
+``labeled_*("name", ...)`` registration or when its receiver's name was
+assigned from one anywhere in the same file. Unresolvable receivers are
+skipped, not guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from scripts.ragcheck.core import Finding, Repo, terminal_attr
+
+DOC = "docs/OBSERVABILITY.md"
+#: the registry implementation itself registers nothing
+_FRAMEWORK = "rag_llm_k8s_tpu/obs/metrics.py"
+
+_REGISTER_CALLS = {
+    "counter", "gauge", "histogram",
+    "labeled_counter", "labeled_gauge", "labeled_histogram",
+}
+_LABELED_CALLS = {"labeled_counter", "labeled_gauge", "labeled_histogram"}
+_FACADE_CALLS = {"inc", "observe"}
+_NAME_OK = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+def _metric_literal(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str) \
+            and _NAME_OK.match(call.args[0].value):
+        return call.args[0].value
+    return None
+
+
+def _is_dynamic_value(expr: ast.AST) -> bool:
+    """f-string, percent-format, or ``"...".format(...)`` label values."""
+    if isinstance(expr, ast.JoinedStr):
+        return True
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Mod) \
+            and isinstance(expr.left, ast.Constant) \
+            and isinstance(expr.left.value, str):
+        return True
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute) \
+            and expr.func.attr == "format":
+        return True
+    return False
+
+
+def _registrations(sf) -> Iterable[Tuple[str, int]]:
+    """Every (metric_name, line) registered in one file."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        t = terminal_attr(node.func)
+        if t in _REGISTER_CALLS or (
+            t in _FACADE_CALLS and isinstance(node.func, ast.Attribute)
+        ):
+            name = _metric_literal(node)
+            if name is not None:
+                yield name, node.lineno
+
+
+def _family_bindings(sf) -> Dict[str, str]:
+    """{local var / attribute name: family name} for labeled_* assignments
+    (``fam = reg.labeled_counter("x")`` and ``self._m_x = ...``)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        val = node.value
+        if not isinstance(val, ast.Call):
+            continue
+        if terminal_attr(val.func) not in _LABELED_CALLS:
+            continue
+        fam = _metric_literal(val)
+        if fam is None:
+            continue
+        for tgt in node.targets:
+            t = terminal_attr(tgt)
+            if t is not None:
+                out[t] = fam
+    return out
+
+
+def _label_sites(sf, bindings: Dict[str, str]):
+    """(family, frozenset(label names), lineno, dynamic kwargs) per
+    resolvable .labels()/.labels_callback() call."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        t = terminal_attr(node.func)
+        if t not in ("labels", "labels_callback"):
+            continue
+        recv = node.func.value if isinstance(node.func, ast.Attribute) else None
+        fam: Optional[str] = None
+        if isinstance(recv, ast.Call) and \
+                terminal_attr(recv.func) in _LABELED_CALLS:
+            fam = _metric_literal(recv)
+        elif recv is not None:
+            rn = terminal_attr(recv)
+            if rn is not None:
+                fam = bindings.get(rn)
+        if fam is None:
+            continue
+        if any(kw.arg is None for kw in node.keywords):
+            continue  # **splat: unresolvable, skip rather than guess
+        names = frozenset(kw.arg for kw in node.keywords)
+        dynamic = [
+            (kw.arg, kw.value.lineno)
+            for kw in node.keywords
+            if _is_dynamic_value(kw.value)
+        ]
+        yield fam, names, node.lineno, dynamic
+
+
+class MetricDriftRule:
+    id = "METRIC-DRIFT"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        doc = repo.text(DOC)
+        registered: Dict[str, Tuple[str, int]] = {}
+        # family -> {labelset -> (path, line) first seen}
+        label_sets: Dict[str, Dict[frozenset, Tuple[str, int]]] = {}
+        for sf in repo.scan_files:
+            if sf.tree is None or sf.path == _FRAMEWORK:
+                continue
+            for name, lineno in _registrations(sf):
+                registered.setdefault(name, (sf.path, lineno))
+            bindings = _family_bindings(sf)
+            for fam, names, lineno, dynamic in _label_sites(sf, bindings):
+                for label, dline in dynamic:
+                    yield Finding(
+                        rule=self.id,
+                        path=sf.path,
+                        line=dline,
+                        message=(
+                            f"label {label!r} of {fam} is dynamically "
+                            "formatted — unbounded label cardinality; use "
+                            "a bounded literal domain"
+                        ),
+                        key=f"dynamic-label:{fam}:{label}",
+                    )
+                label_sets.setdefault(fam, {}).setdefault(
+                    names, (sf.path, lineno)
+                )
+
+        # 1. docs coverage (the absorbed check_metrics_docs gate)
+        if not registered and doc is not None:
+            # the old script's scanner-rot self-check: a tree that SHIPS an
+            # OBSERVABILITY.md but registers zero discoverable metrics
+            # means the matcher broke (API rename, scan-root drift) — the
+            # gate must fail loudly, not go vacuously green forever
+            yield Finding(
+                rule=self.id, path=DOC, line=1,
+                message=(
+                    f"{DOC} exists but the scanner found ZERO metric "
+                    "registrations — the METRIC-DRIFT matcher no longer "
+                    "recognizes the registry API (scanner rot)"
+                ),
+                key="no-registrations-found",
+            )
+        if registered:
+            if doc is None:
+                yield Finding(
+                    rule=self.id, path=DOC, line=1,
+                    message=f"{DOC} missing but metrics are registered",
+                    key="missing-doc",
+                )
+            else:
+                for name, (path, lineno) in sorted(registered.items()):
+                    if f"`{name}`" not in doc and name not in doc:
+                        yield Finding(
+                            rule=self.id,
+                            path=path,
+                            line=lineno,
+                            message=(
+                                f"metric {name} is registered here but "
+                                f"absent from {DOC} — add a table row"
+                            ),
+                            key=f"undocumented:{name}",
+                        )
+
+        # 2. label-name consistency across every emission site of a family
+        for fam, sets in sorted(label_sets.items()):
+            if len(sets) <= 1:
+                continue
+            canon = sorted(sets.items(), key=lambda kv: (kv[1], sorted(kv[0])))
+            canon_names, (cpath, cline) = canon[0]
+            for names, (path, lineno) in canon[1:]:
+                yield Finding(
+                    rule=self.id,
+                    path=path,
+                    line=lineno,
+                    message=(
+                        f"family {fam} emitted with labels "
+                        f"{{{', '.join(sorted(names)) or '∅'}}} here but "
+                        f"{{{', '.join(sorted(canon_names)) or '∅'}}} at "
+                        f"{cpath}:{cline} — one family, one label set"
+                    ),
+                    key=(
+                        f"labelset:{fam}:{'/'.join(sorted(names))}"
+                    ),
+                )

@@ -1,0 +1,182 @@
+"""SHARDING-CONTRACT: executables returning arena/cache state pin shardings.
+
+PR 6's "dense path's lesson": a ``jax.jit`` whose outputs include the KV
+arena/cache planes but whose construction does not pin ``out_shardings``
+lets XLA pick an output layout — on a mesh the state silently gathers to
+one device (or re-lays-out per call), and the next donation round-trip
+either OOMs or quietly de-shards the pool. Every paged executable in
+``engine/continuous.py`` pins its arena outputs for exactly this reason.
+
+Detection: for ``jax.jit(f, ...)``/``pjit(f, ...)`` where ``f`` is a
+function defined in the same module, the rule looks at what ``f`` returns.
+If a returned expression mentions a state-like identifier — ``cache``,
+``arena``, ``plane(s)``, or a ``kv``-prefixed/suffixed name — directly, or
+via the returned name's own assignment one level back (``out = (cache.k,
+cache.v)`` … ``return out``), the jit call must carry an ``out_shardings``
+keyword. Token/logit-returning executables are exempt by construction:
+their returns never name cache state.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List
+
+from scripts.ragcheck.core import (
+    Finding,
+    Repo,
+    ScopedDefIndex,
+    dotted_name,
+)
+
+_STATEFUL = re.compile(r"(cache|arena|plane|^kv$|^kv_|_kv$)", re.IGNORECASE)
+
+
+def _container_names(expr: ast.AST) -> List[str]:
+    """Identifiers in an expression EXCLUDING call subtrees: ``(cache.k,
+    cache.v)`` exposes ``cache`` but ``model.apply(..., cache, ...)`` does
+    not — a function's *result* is not the state that went in."""
+    out: List[str] = []
+
+    def walk(node: ast.AST):
+        if isinstance(node, ast.Call):
+            return
+        if isinstance(node, ast.Name):
+            out.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.append(node.attr)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(expr)
+    return out
+
+
+def _assignment_rhs_names(fn: ast.AST, name: str) -> List[str]:
+    """Call-free identifiers on the RHS of every ``name = ...`` in fn."""
+    out: List[str] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                for t in ast.walk(tgt):
+                    if isinstance(t, ast.Name) and t.id == name:
+                        out.extend(_container_names(node.value))
+    return out
+
+
+def _own_returns(fn: ast.FunctionDef) -> List[ast.Return]:
+    """fn's own Return statements — nested ``def``/``lambda`` bodies return
+    to their own callers (while_loop bodies carry cache state legitimately)
+    and must not be attributed to fn."""
+    out: List[ast.Return] = []
+
+    def walk(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(child, ast.Return) and child.value is not None:
+                out.append(child)
+            walk(child)
+
+    walk(fn)
+    return out
+
+
+def _returns_state(fn: ast.FunctionDef) -> bool:
+    for node in _own_returns(fn):
+        # call-excluding on the direct return too: `return model.apply(...,
+        # cache, ...)[0]` is logits THROUGH a call, not state (the same
+        # exemption the one-level trace applies)
+        names = _container_names(node.value)
+        if any(_STATEFUL.search(n) for n in names):
+            return True
+        # one level of indirection: `out = (cache.k, ...)` ... `return out`
+        for n in names:
+            if any(_STATEFUL.search(r) for r in _assignment_rhs_names(fn, n)):
+                return True
+    return False
+
+
+def _trace_decorator_info(fn: ast.AST):
+    """(is_jit_decorated, has_out_shardings) for ``@jax.jit`` /
+    ``@functools.partial(jax.jit, ...)`` decorator forms."""
+    for dec in getattr(fn, "decorator_list", []):
+        d = dotted_name(dec)
+        if d is not None and d.split(".")[-1] in ("jit", "pjit"):
+            return True, False  # bare @jax.jit cannot pass out_shardings
+        if isinstance(dec, ast.Call):
+            dd = dotted_name(dec.func)
+            if dd is None:
+                continue
+            last = dd.split(".")[-1]
+            if last in ("jit", "pjit"):
+                return True, any(
+                    kw.arg == "out_shardings" for kw in dec.keywords
+                )
+            if last == "partial" and dec.args:
+                a0 = dotted_name(dec.args[0])
+                if a0 is not None and a0.split(".")[-1] in ("jit", "pjit"):
+                    return True, any(
+                        kw.arg == "out_shardings" for kw in dec.keywords
+                    )
+    return False, False
+
+
+class ShardingContractRule:
+    id = "SHARDING-CONTRACT"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        for sf in repo.scan_files:
+            if sf.tree is None:
+                continue
+            index = ScopedDefIndex(sf.tree)
+            for node in ast.walk(sf.tree):
+                # decorator form: @jax.jit / @functools.partial(jax.jit, …)
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    decorated, has_out = _trace_decorator_info(node)
+                    if decorated and not has_out and _returns_state(node):
+                        yield Finding(
+                            rule=self.id,
+                            path=sf.path,
+                            line=node.lineno,
+                            message=(
+                                f"@jit-decorated {node.name} returns "
+                                "arena/cache state but pins no "
+                                "out_shardings — use functools.partial("
+                                "jax.jit, out_shardings=...) (PR 6's "
+                                "dense-path lesson)"
+                            ),
+                            key=f"jit:{index.qualname(node)}",
+                        )
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                if d is None or d.split(".")[-1] not in ("jit", "pjit"):
+                    continue
+                if not node.args or not isinstance(node.args[0], ast.Name):
+                    continue
+                fname = node.args[0].id
+                fns = index.resolve(node, fname)
+                if not fns:
+                    continue
+                has_out = any(kw.arg == "out_shardings" for kw in node.keywords)
+                if has_out:
+                    continue
+                hit = next((fn for fn in fns if _returns_state(fn)), None)
+                if hit is not None:
+                    yield Finding(
+                        rule=self.id,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=(
+                            f"jit({fname}) returns arena/cache state but "
+                            "pins no out_shardings — on a mesh the state "
+                            "silently de-shards on the way out (PR 6's "
+                            "dense-path lesson); pin the output specs"
+                        ),
+                        key=f"jit:{index.qualname(hit)}",
+                    )

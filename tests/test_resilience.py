@@ -84,6 +84,7 @@ class TestFaults:
 
     def test_unknown_site_is_loud(self):
         with pytest.raises(ValueError, match="unknown fault site"):
+            # the bad name is the point here  # ragcheck: disable=FAULT-SITE-REGISTRY
             faults.arm("definitely_not_a_site")
         with pytest.raises(ValueError, match="expected >= 1"):
             faults.arm("embed", times=0)

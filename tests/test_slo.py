@@ -102,6 +102,71 @@ class TestTraceparent:
 # ---------------------------------------------------------------------------
 
 
+class TestSloConfig:
+    """PR 10 satellite: the TPU_RAG_SLO_* knobs route through
+    core/config.py with a SAFE parse — a malformed or out-of-range env
+    value must retune to the default, never raise at scrape/eval time
+    (an out-of-range objective previously survived the float() guard and
+    blew up in SloSpec.__post_init__)."""
+
+    def test_defaults(self):
+        from rag_llm_k8s_tpu.core.config import SloConfig
+
+        cfg = SloConfig.from_env({})
+        assert cfg.availability_objective == 0.999
+        assert cfg.request_p95_s == 2.0
+        assert cfg.ttft_p95_s == 1.0
+
+    def test_valid_overrides_apply(self):
+        from rag_llm_k8s_tpu.core.config import SloConfig
+
+        cfg = SloConfig.from_env({
+            "TPU_RAG_SLO_REQUEST_P95_S": "3.5",
+            "TPU_RAG_SLO_TTFT_P95_OBJECTIVE": "0.9",
+        })
+        assert cfg.request_p95_s == 3.5
+        assert cfg.ttft_p95_objective == 0.9
+
+    def test_malformed_values_fall_back(self):
+        from rag_llm_k8s_tpu.core.config import SloConfig
+
+        cfg = SloConfig.from_env({
+            "TPU_RAG_SLO_REQUEST_P95_S": "two seconds",
+            "TPU_RAG_SLO_AVAILABILITY_OBJECTIVE": "",
+        })
+        assert cfg.request_p95_s == 2.0
+        assert cfg.availability_objective == 0.999
+
+    def test_out_of_range_values_fall_back(self):
+        # 1.5 parses as float but violates SloSpec's (0,1) objective
+        # contract; 0/-1 thresholds violate "latency SLO needs threshold"
+        from rag_llm_k8s_tpu.core.config import SloConfig
+
+        cfg = SloConfig.from_env({
+            "TPU_RAG_SLO_REQUEST_P95_OBJECTIVE": "1.5",
+            "TPU_RAG_SLO_TTFT_P95_S": "0",
+            "TPU_RAG_SLO_REQUEST_P95_S": "-1",
+        })
+        assert cfg.request_p95_objective == 0.95
+        assert cfg.ttft_p95_s == 1.0
+        assert cfg.request_p95_s == 2.0
+
+    def test_default_specs_construct_from_hostile_env(self, monkeypatch):
+        # end-to-end: a hostile environment still yields valid SloSpecs
+        monkeypatch.setenv("TPU_RAG_SLO_REQUEST_P95_S", "bogus")
+        monkeypatch.setenv("TPU_RAG_SLO_AVAILABILITY_OBJECTIVE", "7")
+        specs = obs_slo.default_specs()
+        by_name = {s.name: s for s in specs}
+        assert by_name["request_p95"].threshold_s == 2.0
+        assert by_name["availability"].objective == 0.999
+
+    def test_app_config_threads_slo(self):
+        cfg = AppConfig.from_env({"TPU_RAG_SLO_TTFT_P95_S": "0.75"})
+        assert cfg.slo.ttft_p95_s == 0.75
+        specs = obs_slo.default_specs(cfg.slo)
+        assert {s.name: s for s in specs}["ttft_p95"].threshold_s == 0.75
+
+
 class FakeClock:
     def __init__(self, t=1000.0):
         self.t = t
