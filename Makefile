@@ -72,6 +72,17 @@ lookahead-smoke:
 tiering-smoke:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_kv_tiering.py::TestSmoke -q -p no:cacheprovider
 
+# Flight-recorder smoke (ISSUE 11, docs/OBSERVABILITY.md "Engine flight
+# recorder"): with the fault harness armed, a forced reset storm must
+# produce an incident bundle whose per-request timelines reconstruct each
+# in-flight lifecycle (admit → reset → resubmit → complete) BYTE-
+# CONSISTENT with the streams the clients actually received, and
+# scripts/flightview.py must round-trip the bundle offline. The full
+# matrix (ring semantics, debug-endpoint gating, spool bounds, timeline
+# opt-in) lives in the rest of tests/test_flight.py and runs under tier1.
+flight-smoke:
+	env TPU_RAG_FAULTS=1 JAX_PLATFORMS=cpu python -m pytest tests/test_flight.py::TestFlightSmoke -q -p no:cacheprovider
+
 # Perf regression gate (scripts/bench_gate.py): compare a fresh bench JSON
 # against a committed baseline with per-metric tolerance bands, direction
 # aware (latency up = bad, tok/s down = bad). Defaults to comparing the
@@ -133,7 +144,7 @@ check: test tpu-test bench
 # (validates the baseline + gate plumbing without running the bench — the
 # TPU-judged comparison is `make bench` followed by
 # `make bench-gate BENCH_CURRENT=...`).
-ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke lint analyze
+ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke flight-smoke lint analyze
 	python scripts/bench_gate.py --baseline $(BENCH_BASELINE) --dry-run
 
-.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke ci lint analyze check validate-8b validate-70b
+.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke flight-smoke ci lint analyze check validate-8b validate-70b

@@ -1042,6 +1042,79 @@ def measure_kv_tiering() -> dict:
     }
 
 
+def measure_flight_overhead() -> dict:
+    """Flight-recorder overhead (ISSUE 11 acceptance): B=8 continuous
+    decode steps/s through the PUBLIC ``engine.step()`` path — the one
+    that emits ``sync_window_open/close``/``eos`` into the journal —
+    recorder-on vs recorder-off, with ``overhead_frac`` gated ≤ 2% by
+    ``bench_gate`` (direction: lower).
+
+    Deliberately uses the TINY config: the recorder's absolute per-window
+    cost is fixed (a handful of ring appends), so the FASTEST possible
+    device step is the WORST case for its relative share — a bound that
+    holds a fortiori for the production models, and one this leg can
+    measure on any host. Greedy + fixed seed makes the on/off runs decode
+    identical trajectories, so the division compares pure recorder cost.
+    """
+    import jax
+
+    from rag_llm_k8s_tpu.core.config import (
+        DTypePolicy,
+        EngineConfig,
+        LlamaConfig,
+        SamplingConfig,
+    )
+    from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine
+    from rag_llm_k8s_tpu.models.llama import init_llama_params
+    from rag_llm_k8s_tpu.obs import flight
+
+    cfg = LlamaConfig.tiny(vocab_size=128)
+    params = init_llama_params(jax.random.PRNGKey(0), cfg, DTypePolicy.fp32())
+    B, SYNC, WINDOWS = 8, 8, 8  # 1 settle + 3 passes × 8 windows ≤ budget
+
+    def steps_per_s(enabled: bool) -> float:
+        rec_was = flight.recorder().enabled
+        flight.configure(enabled=enabled)
+        try:
+            eng = ContinuousEngine(
+                cfg, params,
+                sampling=SamplingConfig(do_sample=False, max_new_tokens=224),
+                engine_config=EngineConfig(
+                    prompt_buckets=(32,), max_batch_size=B, max_seq_len=256,
+                    decode_sync_steps=SYNC,
+                ),
+                dtypes=DTypePolicy.fp32(),
+            )
+            eng.warmup(batch_sizes=(B,))
+            eng.admit_many([
+                (i + 1, [cfg.bos_token_id] + [3 + i] * 20, 224, None)
+                for i in range(B)
+            ])
+            eng.step()  # settle the pipeline
+            best = 1e9
+            for _ in range(3):
+                t0 = time.monotonic()
+                for _ in range(WINDOWS):
+                    eng.step()
+                best = min(best, time.monotonic() - t0)
+            del eng
+            return WINDOWS * SYNC / best
+        finally:
+            flight.configure(enabled=rec_was)
+
+    on = steps_per_s(True)
+    off = steps_per_s(False)
+    return {
+        "flight_overhead": {
+            "b8_steps_per_s_on": round(on, 1),
+            "b8_steps_per_s_off": round(off, 1),
+            # floor at 0: run-to-run noise must not report a negative
+            # "overhead" that a later regression reads as a baseline gain
+            "overhead_frac": round(max(0.0, 1.0 - on / off), 4),
+        }
+    }
+
+
 def measure_ingest_scale() -> dict:
     """VERDICT r4 #6: corpus-scale ingest THROUGH the HTTP path, snapshot
     save/load timing at that size, and live-index /query probes.
@@ -2406,6 +2479,7 @@ def bench_legs(line: dict):
         ("paged_tp", lambda: line.update(measure_paged_tp())),
         ("lookahead_overlap", lambda: line.update(measure_lookahead_overlap())),
         ("kv_tiering", lambda: line.update(measure_kv_tiering())),
+        ("flight_overhead", lambda: line.update(measure_flight_overhead())),
         ("query_e2e", lambda: line.update(measure_query_e2e())),
         ("ingest_scale", lambda: line.update(measure_ingest_scale())),
     ]

@@ -700,6 +700,77 @@ class SloConfig:
         )
 
 
+@dataclass(frozen=True)
+class FlightConfig:
+    """Engine flight recorder + incident bundles (obs/flight.py).
+
+    The recorder is ON BY DEFAULT: it is the post-mortem signal, and its
+    measured cost is a bounded ring append per scheduler decision (the
+    ``flight_overhead`` bench leg pins it at ≤ 2% of B=8 decode steps/s).
+    """
+
+    # master switch for the in-process event journal (env TPU_RAG_FLIGHT)
+    enabled: bool = True
+    # ring capacity in events — the journal's memory bound; sized so a
+    # breaker-flip bundle still holds the storm's whole causal prefix
+    # (env TPU_RAG_FLIGHT_EVENTS)
+    capacity: int = 4096
+    # incident-bundle spool: directory, file cap (oldest pruned), and the
+    # per-trigger cooldown that keeps a reset storm from writing a bundle
+    # per reset (env TPU_RAG_FLIGHT_SPOOL / TPU_RAG_FLIGHT_SPOOL_MAX /
+    # TPU_RAG_FLIGHT_COOLDOWN_S)
+    spool_dir: str = "/tmp/tpu_rag_incidents"
+    spool_max: int = 16
+    cooldown_s: float = 30.0
+    # arm the READ-ONLY debug surface (/debug/traces, /debug/timeline,
+    # /debug/incidents) without arming fault injection: every /debug route
+    # is 403 unless the process started with TPU_RAG_DEBUG=1 or
+    # TPU_RAG_FAULTS set (the faults endpoint additionally requires
+    # TPU_RAG_FAULTS itself — arming stays strictly opt-in)
+    # (env TPU_RAG_DEBUG)
+    debug_endpoints: bool = False
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "FlightConfig":
+        env = dict(os.environ if env is None else env)
+        out = cls()
+
+        def _flag(var: str, field_name: str):
+            nonlocal out
+            if var in env:
+                flag = env[var]
+                if flag not in ("0", "1"):
+                    raise ValueError(f"{var}={flag!r}: expected '0' or '1'")
+                out = dataclasses.replace(out, **{field_name: flag == "1"})
+
+        _flag("TPU_RAG_FLIGHT", "enabled")
+        _flag("TPU_RAG_DEBUG", "debug_endpoints")
+        if "TPU_RAG_FLIGHT_EVENTS" in env:
+            n = int(env["TPU_RAG_FLIGHT_EVENTS"])
+            if n < 1:
+                raise ValueError(f"TPU_RAG_FLIGHT_EVENTS={n}: expected >= 1")
+            out = dataclasses.replace(out, capacity=n)
+        if "TPU_RAG_FLIGHT_SPOOL" in env:
+            out = dataclasses.replace(
+                out, spool_dir=env["TPU_RAG_FLIGHT_SPOOL"]
+            )
+        if "TPU_RAG_FLIGHT_SPOOL_MAX" in env:
+            n = int(env["TPU_RAG_FLIGHT_SPOOL_MAX"])
+            if n < 1:
+                raise ValueError(
+                    f"TPU_RAG_FLIGHT_SPOOL_MAX={n}: expected >= 1"
+                )
+            out = dataclasses.replace(out, spool_max=n)
+        if "TPU_RAG_FLIGHT_COOLDOWN_S" in env:
+            v = float(env["TPU_RAG_FLIGHT_COOLDOWN_S"])
+            if v < 0:
+                raise ValueError(
+                    f"TPU_RAG_FLIGHT_COOLDOWN_S={v}: expected >= 0"
+                )
+            out = dataclasses.replace(out, cooldown_s=v)
+        return out
+
+
 # ---------------------------------------------------------------------------
 # top-level
 # ---------------------------------------------------------------------------
@@ -727,6 +798,7 @@ class AppConfig:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     lookahead: LookaheadConfig = field(default_factory=LookaheadConfig)
     slo: SloConfig = field(default_factory=SloConfig)
+    flight: FlightConfig = field(default_factory=FlightConfig)
     system_message: str = SYSTEM_MESSAGE
 
     @classmethod
@@ -958,4 +1030,5 @@ class AppConfig:
             cfg, server=server, mesh=mesh, sampling=sampling, engine=engine,
             resilience=resilience, lookahead=lookahead,
             slo=SloConfig.from_env(env),
+            flight=FlightConfig.from_env(env),
         )

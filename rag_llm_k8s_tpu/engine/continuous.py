@@ -31,6 +31,7 @@ path remains the fastest way to run a KNOWN batch (bench.py uses it).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import queue
 import random
@@ -65,12 +66,19 @@ from rag_llm_k8s_tpu.models.llama import (
     make_kv_cache,
     mask_window,
 )
+from rag_llm_k8s_tpu.obs import flight
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
 from rag_llm_k8s_tpu.resilience import faults
 from rag_llm_k8s_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from rag_llm_k8s_tpu.utils.buckets import bucket_len
 
 logger = logging.getLogger(__name__)
+
+# request ids are PROCESS-global (not per-scheduler): the flight journal
+# (obs/flight.py) keys every lifecycle event on this id, and two schedulers
+# in one process (bench legs, tests) must never alias each other's
+# timelines. itertools.count is atomic under CPython — no lock needed.
+_REQUEST_IDS = itertools.count(1)
 
 
 class EngineStateLost(RuntimeError):
@@ -406,6 +414,10 @@ class ContinuousEngine:
         (cache, kv_len, last_tok, active) — merely deactivating slots would
         leave the next admit holding deleted arrays, bricking the engine
         while /healthz still reports ready."""
+        flight.emit(
+            "reset",
+            in_flight=sum(1 for s in self.slots if s.active),
+        )
         self.slots = [_Slot() for _ in range(self.B)]
         self._cache = self._fresh_cache()
         self._kv_start = self._put(jnp.zeros((self.B,), jnp.int32))
@@ -712,6 +724,10 @@ class ContinuousEngine:
         self.stats.generate_calls += 1
         self.stats.prefill_tokens += len(suffix)
         self.stats.prefill_tokens_skipped += int(prefix.length)
+        flight.emit(
+            "admit", request_id, slot=row, prompt_len=total,
+            prefix_len=int(prefix.length), tok0=tok0,
+        )
         if tok0 in self.config.eos_token_ids or max_new_c <= 1:
             out = [] if tok0 in self.config.eos_token_ids else [tok0]
             self.stats.decode_tokens += len(out)
@@ -804,6 +820,10 @@ class ContinuousEngine:
         self.stats.generate_calls += 1
         self.stats.prefill_tokens += slen
         self.stats.prefill_tokens_skipped += plen
+        flight.emit(
+            "admit", request_id, slot=row, prompt_len=total, prefix_len=plen,
+            shared=shared_tok, tok0=tok0,
+        )
         if tok0 in self.config.eos_token_ids or max_new_c <= 1:
             out = [] if tok0 in self.config.eos_token_ids else [tok0]
             self.stats.decode_tokens += len(out)
@@ -1586,6 +1606,10 @@ class ContinuousEngine:
                     ok = False
                     break
                 self._assign_row_blocks(row, ids, start_block=have)
+                flight.emit(
+                    "block_grow", self.slots[row].request_id,
+                    blocks=missing, total=have + missing,
+                )
             if ok:
                 return
             # growth blocked: drop registered prefix blocks first (cache
@@ -1617,6 +1641,11 @@ class ContinuousEngine:
             )
             self._preempted.append((vslot.request_id, list(vslot.tokens)))
             self._m_pool_preempt.inc()
+            flight.emit(
+                "preempt", vslot.request_id,
+                blocks=len(self._slot_blocks[victim]),
+                n_tokens=len(vslot.tokens),
+            )
             m = np.ones(self.B, bool)
             m[victim] = False
             self._active = self._active & self._put(jnp.asarray(m))
@@ -1675,6 +1704,11 @@ class ContinuousEngine:
             m = np.ones(self.B, bool)
             m[rows] = False
             self._active = self._active & self._put(jnp.asarray(m))
+            for r in rows:
+                flight.emit(
+                    "evict", self.slots[r].request_id,
+                    n_tokens=len(self.slots[r].tokens),
+                )
             self._retire_rows(rows)  # paged: blocks back to the free list
             for r in rows:
                 self.slots[r] = _Slot()
@@ -1817,6 +1851,10 @@ class ContinuousEngine:
                 row = rows[r]
                 self.stats.generate_calls += 1
                 self.stats.prefill_tokens += len(p)
+                flight.emit(
+                    "admit", rid, slot=row, prompt_len=len(p), bucket=S,
+                    tok0=tok0,
+                )
                 if tok0 in self.config.eos_token_ids or max_new_c <= 1:
                     # finished at its very first token: the slot was spliced
                     # active by the batched insert — release it on device too
@@ -1924,6 +1962,10 @@ class ContinuousEngine:
                 row = rows[r]
                 self.stats.generate_calls += 1
                 self.stats.prefill_tokens += len(p)
+                flight.emit(
+                    "admit", rid, slot=row, prompt_len=len(p), bucket=S,
+                    tok0=tok0,
+                )
                 if tok0 in self.config.eos_token_ids or max_new_c <= 1:
                     out = [] if tok0 in self.config.eos_token_ids else [tok0]
                     self.stats.decode_tokens += len(out)
@@ -1966,6 +2008,10 @@ class ContinuousEngine:
             self._ensure_decode_blocks()
             if not self.has_active():
                 return []  # everything was preempted: nothing to step
+        flight.emit(
+            "sync_window_open", steps=k,
+            active=sum(1 for s in self.slots if s.active),
+        )
         t0 = time.perf_counter()
         if self.paged:
             (self._cache, self._kv_len, self._last_tok, toks, eoss,
@@ -2010,6 +2056,11 @@ class ContinuousEngine:
                     break
             if finished:
                 done.append((slot.request_id, slot.tokens))
+                flight.emit(
+                    "eos", slot.request_id,
+                    reason="budget" if slot.remaining <= 0 else "eos",
+                    n_tokens=len(slot.tokens),
+                )
                 slot.active = False
                 deactivate.append(i)
         if deactivate:
@@ -2020,6 +2071,10 @@ class ContinuousEngine:
             self._active = self._active & self._put(jnp.asarray(mask))
             self._retire_rows(deactivate)  # paged: blocks back to the pool
         self._m_step_drain.observe(time.perf_counter() - t_fetch)
+        flight.emit(
+            "sync_window_close", steps=k, done=len(done),
+            duration_ms=round((time.perf_counter() - t0) * 1e3, 3),
+        )
         return done
 
 
@@ -2059,8 +2114,6 @@ class ContinuousScheduler:
         self.breaker = None
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
         self._stop = threading.Event()
-        self._next_id = 0
-        self._id_lock = threading.Lock()
         # serializes the stop-check+enqueue in submit() against shutdown()'s
         # final drain — without it an item can land in the queue after the
         # drain and block its caller forever
@@ -2112,9 +2165,11 @@ class ContinuousScheduler:
         )
         if max_new <= 0:
             return []
-        with self._id_lock:
-            self._next_id += 1
-            rid = self._next_id
+        rid = next(_REQUEST_IDS)  # process-global: flight-journal identity
+        if info is not None:
+            # out-param: the flight journal keys this request's lifecycle
+            # timeline on the id (GET /debug/timeline/<id>)
+            info["request_id"] = rid
         item = _Pending(
             request_id=rid, prompt=list(prompt), max_new=max_new, seed=seed,
             deadline=deadline, retries_left=self.retries,
@@ -2373,6 +2428,13 @@ class ContinuousScheduler:
             self._m_retries.labels(outcome="succeeded").inc()
         item.blocks_allocated = self.engine.pop_blocks_allocated(item.request_id)
         item.result = item.emitted + tokens
+        # stream_fnv anchors the timeline to the BYTES the client received:
+        # a reconstructed lifecycle (admit → reset → resubmit → complete)
+        # is provably consistent with the delivered stream
+        flight.emit(
+            "complete", item.request_id, n_tokens=len(item.result),
+            stream_fnv=flight.stream_hash(item.result),
+        )
         item.done.set()
 
     def _fold_emitted(self, it: "_Pending", toks: List[int]) -> None:
@@ -2399,6 +2461,10 @@ class ContinuousScheduler:
                 continue
             self._fold_emitted(it, toks)
             it.resumed = True
+            flight.emit(
+                "resubmit", rid, outcome="preempt_resume",
+                n_emitted=len(toks),
+            )
             self._queue.put(it)
 
     def _handle_reset(self, cause, waiting, extra, emitted):
@@ -2419,6 +2485,7 @@ class ContinuousScheduler:
                 retry.append(it)
             else:
                 self._m_retries.labels(outcome="gave_up").inc()
+                flight.emit("resubmit", it.request_id, outcome="gave_up")
                 it.error = cause
                 it.done.set()
         if not retry:
@@ -2432,10 +2499,15 @@ class ContinuousScheduler:
             # retries' prefills land on it again
             time.sleep(random.uniform(0.5, 1.0) * self.retry_backoff_s)
         for it in retry:
-            self._fold_emitted(it, emitted.get(it.request_id, []))
+            toks = emitted.get(it.request_id, [])
+            self._fold_emitted(it, toks)
             it.retries_left -= 1
             it.retried = True
             self._m_retries.labels(outcome="resubmitted").inc()
+            flight.emit(
+                "resubmit", it.request_id, outcome="resubmitted",
+                n_emitted=len(toks),
+            )
             self._queue.put(it)
 
     def _run_engine_task(self, task, waiting: Dict[int, "_Pending"]):

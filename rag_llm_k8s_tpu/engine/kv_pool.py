@@ -39,6 +39,8 @@ import threading
 from collections import deque
 from typing import Dict, Iterable, List
 
+from rag_llm_k8s_tpu.obs import flight
+
 __all__ = ["KVBlockPool", "PoolExhausted", "NULL_BLOCK"]
 
 # physical block 0: reserved write-sink / never-read placeholder (see module
@@ -141,14 +143,23 @@ class KVBlockPool:
         if n <= 0:
             return []
         with self._lock:
-            if n > len(self._free):
+            free = len(self._free)
+            if n > free:
                 self.total_exhaustions += 1
-                raise PoolExhausted(n, len(self._free))
-            ids = [self._free.pop() for _ in range(n)]
-            for b in ids:
-                self._refs[b] = 1
-            self.total_allocs += n
-            return ids
+                ids = None
+            else:
+                ids = [self._free.pop() for _ in range(n)]
+                for b in ids:
+                    self._refs[b] = 1
+                self.total_allocs += n
+                free -= n
+        # journal outside the lock: the flight recorder is lock-cheap but
+        # the allocator's lock is on the admission hot path
+        if ids is None:
+            flight.emit("pool_exhausted", requested=n, free=free)
+            raise PoolExhausted(n, free)
+        flight.emit("pool_alloc", blocks=n, free=free)
+        return ids
 
     def ref(self, ids: Iterable[int]) -> None:
         """Add one reference to each block (prefix sharing: a row mapping a
@@ -180,6 +191,9 @@ class KVBlockPool:
                     reclaimed += 1
                 else:
                     self._refs[b] = refs - 1
+            free = len(self._free)
+        if reclaimed:
+            flight.emit("pool_free", blocks=reclaimed, free=free)
         return reclaimed
 
     def refcount(self, block: int) -> int:

@@ -53,6 +53,7 @@ from rag_llm_k8s_tpu.engine.tiering import (
     dequantize_planes,
     quantize_planes,
 )
+from rag_llm_k8s_tpu.obs import flight
 from rag_llm_k8s_tpu.resilience import faults
 
 logger = logging.getLogger(__name__)
@@ -310,6 +311,9 @@ class PrefixCache:
             else:
                 hit = None
         if hit is not None:
+            flight.emit(
+                "prefix_hit", segments=len(segments), tokens=total, memo=1,
+            )
             # memo-dominated traffic must still converge: a service whose
             # live mix is all memo hits would otherwise never demote idle
             # entries nor fire the cache→pool tier mirror (interval-gated,
@@ -424,19 +428,31 @@ class PrefixCache:
             # assembled buffers are full-capacity (P-wide) planes — at 8B
             # defaults ~512 MiB EACH — so they share the ONE HBM budget with
             # the segment blocks and, being pure re-splice avoidance, evict
-            # FIRST (oldest chain first; the buffer just added is kept so a
-            # repeat of this very query still skips its splices)
+            # FIRST (coldest chain first, then oldest; the buffer just
+            # added is kept so a repeat of this very query still skips its
+            # splices)
             budget = int(self.config.hbm_budget_mb) * (1 << 20)
             cap = max(1, int(self.config.assembled_cache_entries))
-            for k in list(self._assembled):
-                if (
-                    len(self._assembled) <= cap
-                    and self.entry_bytes + self.assembled_bytes <= budget
-                ):
-                    break
-                if k == akey:
-                    continue
-                self._pop_assembled(k)
+            if (
+                len(self._assembled) > cap
+                or self.entry_bytes + self.assembled_bytes > budget
+            ):
+                # order computed only under pressure: ranking every memo's
+                # chain tier scores every member segment, too much for the
+                # common nothing-to-evict resolve
+                for k in self._assembled_evict_order():
+                    if (
+                        len(self._assembled) <= cap
+                        and self.entry_bytes + self.assembled_bytes <= budget
+                    ):
+                        break
+                    if k == akey:
+                        continue
+                    self._pop_assembled(k)
+        if n_hit:
+            flight.emit("prefix_hit", segments=n_hit, tokens=reused)
+        if n_miss:
+            flight.emit("prefix_miss", segments=n_miss, tokens=computed)
         # opportunistic tier maintenance (interval-gated; no-op untiered):
         # demotions ride the resolve path so a quiet cache still converges
         # without a dedicated thread — the lookahead sweeper's stage()
@@ -562,6 +578,8 @@ class PrefixCache:
                     continue
                 self._spill_host_locked(ek, e, host)
                 moved += 1
+        if moved:
+            flight.emit("retier", moved=moved)
         if moved and self.on_retier is not None:
             try:
                 self.on_retier()
@@ -692,6 +710,7 @@ class PrefixCache:
                 "kv swap-in failed for %r; falling back to recompute",
                 ek, exc_info=True,
             )
+            flight.emit("swap_in_fallback")
             return None
         with self._lock:
             e = self._entries.get(ek)
@@ -710,6 +729,7 @@ class PrefixCache:
                 else "swap_ins_demand"
             )
             self._tier_counts[key] += 1
+            flight.emit("swap_in", trigger=trigger)
             if e.tier == "warm" and score >= self.tiering.warm_below:
                 # the hit that triggered this swap already re-heated the
                 # chunk: promote in the same install (rehit contract)
@@ -773,6 +793,23 @@ class PrefixCache:
         return out
 
     # -- LRU bookkeeping -------------------------------------------------
+    def _assembled_evict_order(self) -> List[tuple]:
+        """Assembled-memo eviction order (lock held by the caller):
+        COLDEST chain first — a memo whose coldest member segment demoted
+        is re-splice avoidance for a chain the tier policy already judged
+        idle, so its full-capacity buffer is the cheapest HBM to give back
+        (the open item carried since the tiering PR) — then LRU within a
+        tier. Untiered caches keep pure LRU (every chain reads "hot")."""
+        keys = list(self._assembled)  # OrderedDict: LRU-oldest first
+        if self.tiering is None:
+            return keys
+        rank = {"cold": 0, "warm": 1, "hot": 2}
+        order = {k: i for i, k in enumerate(keys)}
+        return sorted(
+            keys,
+            key=lambda k: (rank.get(self.chain_tier(k), 2), order[k]),
+        )
+
     def _pop_assembled(self, key) -> bool:
         """Drop one assembled buffer + its use/stamp side-table rows (the
         one place all three stay consistent; lock held by the caller)."""
@@ -800,17 +837,17 @@ class PrefixCache:
     def _enforce_budget_locked(self, keep) -> None:
         """Evict down to the HBM budget (lock held). Assembled buffers
         (pure re-splice avoidance) evict before any segment block does — a
-        block eviction costs a real re-prefill; then blocks evict
-        LRU-first. Pinned blocks (the head — reused by 100% of requests)
+        block eviction costs a real re-prefill — coldest chain first under
+        tiering (``_assembled_evict_order``); then blocks evict LRU-first. Pinned blocks (the head — reused by 100% of requests)
         and ``keep`` (the entry just inserted / swapped in) are never
         victims, and cold entries are skipped — they hold no device bytes
         to reclaim."""
         budget = int(self.config.hbm_budget_mb) * (1 << 20)
-        while (
-            self._assembled
-            and self.entry_bytes + self.assembled_bytes > budget
-        ):
-            self._pop_assembled(next(iter(self._assembled)))
+        if self._assembled and self.entry_bytes + self.assembled_bytes > budget:
+            for k in self._assembled_evict_order():
+                if self.entry_bytes + self.assembled_bytes <= budget:
+                    break
+                self._pop_assembled(k)
         for k in list(self._entries):
             if self.entry_bytes <= budget:
                 break

@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from rag_llm_k8s_tpu.obs import flight
+
 __all__ = [
     "TIERS",
     "HotnessTracker",
@@ -141,6 +143,7 @@ class HostSpillStore:
         inserted is never its own victim)."""
         host = tuple(np.asarray(p) for p in planes)
         nbytes = int(sum(p.nbytes for p in host))
+        evicted = 0
         with self._lock:
             self._drop_locked(key)
             self._data[key] = (host, dict(meta or {}), nbytes)
@@ -153,7 +156,12 @@ class HostSpillStore:
                     break
                 self._drop_locked(victim)
                 self.evictions += 1
-            return nbytes
+                evicted += 1
+        if evicted:
+            # a budget-evicted cold chunk can never swap back in — its
+            # next use is a plain recompute; the journal names the moment
+            flight.emit("host_spill_evict", evicted=evicted, bytes=self.bytes)
+        return nbytes
 
     def get(self, key) -> Optional[Tuple[Tuple[np.ndarray, ...], dict]]:
         with self._lock:

@@ -24,6 +24,14 @@ The decision layer on top (ISSUE 3):
 - ``obs.devices`` — per-device HBM / prefix-cache residency gauges;
 - ``obs.regression`` — the direction-aware bench regression comparator
   behind ``make bench-gate``.
+
+The causal layer (ISSUE 11):
+
+- ``obs.flight`` — the engine flight recorder: a bounded in-process
+  journal of typed scheduler/substrate decision events, per-request
+  lifecycle timelines (``/debug/timeline/<id>``), and trigger-driven
+  incident bundles (``/debug/incidents``; rendered offline by
+  ``scripts/flightview.py``).
 """
 
 from rag_llm_k8s_tpu.obs.metrics import MetricsRegistry, default_registry  # noqa: F401

@@ -1,0 +1,427 @@
+"""Engine flight recorder: a causal event journal for the serving substrate.
+
+PR 2 made the stack report *what* is happening (metrics/traces), PR 3 *when
+to care* (SLO burn rates), PR 4 how to *act under stress* (shed, evict,
+reset, resubmit). What was still invisible is *why*: when the breaker flips
+or a reset storm hits, the causal sequence of scheduler decisions — admit →
+preempt → retier → swap-in → resubmit — exists only as counters that move
+in aggregate. This module is the journal those decisions write to:
+
+- :data:`EVENTS` — the CLOSED catalog of typed event names (same contract
+  as ``resilience/faults.SITES``: a typo'd event name is a programming
+  error, not a silently-empty timeline). Every decision point in the
+  serving substrate calls ``flight.emit("<type>", ...)``; ragcheck's
+  EVENT-REGISTRY rule pins emit sites ↔ catalog ↔ docs three ways.
+- :class:`FlightRecorder` — a fixed-size ring of monotonic-stamped events.
+  One append under one tiny lock, never any device work; the hot decode
+  path pays ~a microsecond per sync window (the ``flight_overhead`` bench
+  leg holds the recorder to ≤ 2% of B=8 decode steps/s). On by default.
+- **timeline reconstruction** — events carry the scheduler request id, so
+  ``timeline(rid)`` returns one request's ordered event chain with
+  inter-event deltas (``GET /debug/timeline/<id>``; ``{"timeline": true}``
+  on ``/generate`` opts the response in).
+- :class:`IncidentSpooler` — trigger-driven post-mortem bundles: breaker
+  flip, reset storm, pool-exhaustion shed, and deadline expiry snapshot
+  the recent journal + the metrics registry + a config fingerprint + the
+  trace ring into ONE self-contained JSON file on a bounded on-disk spool
+  (``GET /debug/incidents``), so reconstructing an incident needs no live
+  pod. ``scripts/flightview.py`` renders a bundle offline.
+
+The journal is a STABLE CONTRACT: every event and bundle carries
+:data:`SCHEMA_VERSION`, bumped whenever an event's meaning or a bundle
+field changes shape (docs/OBSERVABILITY.md documents both).
+
+Configuration comes through ``core/config.py::FlightConfig`` (env
+``TPU_RAG_FLIGHT*``) — this module reads no environment itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional
+
+__all__ = [
+    "EVENTS",
+    "SCHEMA_VERSION",
+    "FlightRecorder",
+    "IncidentSpooler",
+    "config_fingerprint",
+    "configure",
+    "emit",
+    "recorder",
+    "stream_hash",
+]
+
+logger = logging.getLogger(__name__)
+
+#: Journal/bundle schema version. Bump when an event's attrs change
+#: meaning or a bundle field changes shape; flightview refuses newer
+#: schemas it does not know.
+SCHEMA_VERSION = 1
+
+# The closed event catalog: name -> what the event records. Every entry is
+# emitted by >= 1 call site in the package and documented in
+# docs/OBSERVABILITY.md (ragcheck EVENT-REGISTRY enforces all three ways).
+EVENTS: Dict[str, str] = {
+    # -- continuous engine / scheduler (engine/continuous.py) ------------
+    "admit": "request admitted into a decode slot (slot, prompt_len, "
+             "bucket, tok0; prefixed admissions add prefix_len/shared)",
+    "sync_window_open": "decode sync window dispatched (steps, active rows)",
+    "sync_window_close": "decode sync window drained (steps, rows done, "
+                         "duration_ms)",
+    "eos": "row finished decoding (reason: eos | budget; n_tokens)",
+    "preempt": "row preempted mid-decode by pool exhaustion (blocks "
+               "returned); the scheduler resubmits it",
+    "evict": "row evicted mid-decode (deadline expiry / caller gone)",
+    "block_grow": "row's block table grown ahead of a sync window "
+                  "(blocks added, total mapped)",
+    "reset": "engine device state rebuilt after a failed step/insert "
+             "(every in-flight slot wiped)",
+    "resubmit": "in-flight request re-queued after a reset or preemption "
+                "(outcome: resubmitted | preempt_resume | gave_up; "
+                "n_emitted tokens carried over)",
+    "complete": "request delivered (n_tokens, stream_fnv — FNV-1a over "
+                "the emitted token stream, the byte-consistency anchor)",
+    # -- KV block pool (engine/kv_pool.py) -------------------------------
+    "pool_alloc": "physical KV blocks taken from the pool (blocks, free "
+                  "remaining)",
+    "pool_free": "physical KV blocks returned to the pool (blocks, free)",
+    "pool_exhausted": "an allocation the pool could not serve (requested, "
+                      "free) — backpressure, not failure",
+    # -- prefix cache + tiering (engine/prefix_cache.py, engine/tiering.py)
+    "prefix_hit": "segment KV served from the prefix cache (segments, "
+                  "tokens; memo=1 when the whole assembled chain hit)",
+    "prefix_miss": "segment KV built fresh on the resolve path (segments, "
+                   "tokens prefilled)",
+    "retier": "a tier-maintenance sweep moved entries between hotness "
+              "tiers (moved)",
+    "swap_in": "cold-tier chunk KV swapped host→HBM (trigger: lookahead — "
+               "prefetched off the critical path; demand — on a serving "
+               "tail)",
+    "swap_in_fallback": "a failed swap-in fell back to "
+                        "recompute-from-tokens (host buffer released)",
+    "host_spill_evict": "the host spill store's byte budget evicted a "
+                        "cold chunk's backing (bytes)",
+    # -- retrieval lookahead (rag/lookahead.py) --------------------------
+    "lookahead_launch": "retrieval launched ahead of need (trigger: "
+                        "admission | session)",
+    "lookahead_join": "serving tail joined its retrieval (outcome: hit | "
+                      "late | miss)",
+    "lookahead_waste": "a lookahead retrieval died unconsumed (reason: "
+                       "superseded | expired | abandoned | stale | failed)",
+    "prestage": "a resolved retrieval's chunk KV pre-staged ahead of "
+                "admission (prefix-cache entries / pool registration)",
+    # -- resilience (resilience/) ----------------------------------------
+    "shed": "request rejected at the admission gate (reason, status)",
+    "deadline": "a request's end-to-end deadline expired (stage)",
+    "breaker_open": "the engine-reset circuit breaker flipped open "
+                    "(resets in window) — readiness goes 503",
+}
+
+
+def stream_hash(tokens: Iterable[int]) -> int:
+    """FNV-1a (64-bit) over a token stream — the cheap content identity a
+    ``complete`` event records so a timeline can be checked byte-consistent
+    against the stream the client actually received."""
+    h = 0xCBF29CE484222325
+    for t in tokens:
+        h ^= int(t) & 0xFFFFFFFFFFFFFFFF
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class FlightRecorder:
+    """Bounded in-process event journal.
+
+    A fixed-size ring of ``(seq, t_monotonic, type, request_id, attrs)``
+    tuples. ``emit`` takes ONE tiny lock to claim a slot and write the
+    tuple — no allocation beyond the tuple/attrs the caller already built,
+    no device work, no I/O — so it is safe at every decision point
+    including the per-window decode path. Readers (``snapshot`` /
+    ``timeline``) copy the ring under the same lock; events are immutable
+    tuples, so a snapshot is always internally consistent.
+    """
+
+    def __init__(self, capacity: int = 4096, enabled: bool = True):
+        if capacity < 1:
+            raise ValueError(f"capacity={capacity}: expected >= 1")
+        self.capacity = int(capacity)
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._buf: List[Optional[tuple]] = [None] * self.capacity
+        self._next = 0  # total events ever emitted (seq of the next event)
+
+    # -- write -----------------------------------------------------------
+    def emit(self, etype: str, request_id: Optional[int] = None,
+             **attrs) -> None:
+        """Append one event. Unknown event types raise — the catalog is
+        closed (a typo'd type would journal nothing, silently)."""
+        if not self.enabled:
+            return
+        if etype not in EVENTS:
+            raise ValueError(
+                f"unknown flight event {etype!r}; the catalog is "
+                f"flight.EVENTS"
+            )
+        ev = (0, time.monotonic(), etype, request_id, attrs)
+        with self._lock:
+            seq = self._next
+            self._next = seq + 1
+            # the seq is stamped under the lock so journal order and slot
+            # claim agree even across producers
+            self._buf[seq % self.capacity] = (seq,) + ev[1:]
+
+    # -- read ------------------------------------------------------------
+    @property
+    def events_emitted(self) -> int:
+        with self._lock:
+            return self._next
+
+    def _events_locked(self) -> List[tuple]:
+        live = [e for e in self._buf if e is not None]
+        live.sort(key=lambda e: e[0])
+        return live
+
+    def snapshot(self, request_id: Optional[int] = None,
+                 etype: Optional[str] = None) -> List[Dict]:
+        """The journal's surviving events, oldest first, as JSON-ready
+        dicts (the incident bundle's ``journal`` field)."""
+        with self._lock:
+            live = self._events_locked()
+        out = []
+        for seq, t, typ, rid, attrs in live:
+            if request_id is not None and rid != request_id:
+                continue
+            if etype is not None and typ != etype:
+                continue
+            d = {"seq": seq, "t": round(t, 6), "type": typ}
+            if rid is not None:
+                d["rid"] = rid
+            if attrs:
+                d.update(attrs)
+            out.append(d)
+        return out
+
+    def timeline(self, request_id: int) -> Dict:
+        """One request's ordered event chain with inter-event deltas —
+        the ``GET /debug/timeline/<id>`` / ``{"timeline": true}`` payload.
+        Times are relative to the request's first surviving event."""
+        evs = self.snapshot(request_id=request_id)
+        t0 = evs[0]["t"] if evs else 0.0
+        prev = t0
+        out = []
+        for e in evs:
+            t = e.pop("t")
+            e["t_ms"] = round((t - t0) * 1e3, 3)
+            e["dt_ms"] = round((t - prev) * 1e3, 3)
+            prev = t
+            e.pop("rid", None)  # redundant inside a per-request timeline
+            out.append(e)
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "request_id": request_id,
+            "events": out,
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf = [None] * self.capacity
+            self._next = 0
+
+
+# the process recorder: decision points across the package write here via
+# the module-level ``emit`` (the same singleton pattern as faults.py — the
+# journal must see every layer's events in ONE causal order, and engines
+# are constructed long before any service exists to hand them a handle)
+_RECORDER = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def configure(enabled: Optional[bool] = None,
+              capacity: Optional[int] = None) -> FlightRecorder:
+    """Apply ``FlightConfig`` to the process recorder (the service calls
+    this at construction; bench legs toggle ``enabled`` directly). A
+    capacity change rebuilds the ring (journal starts fresh); an
+    enabled-only change keeps it."""
+    global _RECORDER
+    if capacity is not None and int(capacity) != _RECORDER.capacity:
+        _RECORDER = FlightRecorder(
+            int(capacity),
+            _RECORDER.enabled if enabled is None else bool(enabled),
+        )
+    elif enabled is not None:
+        _RECORDER.enabled = bool(enabled)
+    return _RECORDER
+
+
+def emit(etype: str, request_id: Optional[int] = None, **attrs) -> None:
+    """The one instrumentation entry point: append ``etype`` to the
+    process journal (free when the recorder is disabled)."""
+    rec = _RECORDER
+    if not rec.enabled:
+        return
+    rec.emit(etype, request_id, **attrs)
+
+
+# ---------------------------------------------------------------------------
+# incident bundles
+# ---------------------------------------------------------------------------
+
+
+def config_fingerprint(config) -> Dict:
+    """A bundle's config identity: the full (dataclass) config rendered to
+    plain JSON types plus a stable sha256 digest — enough to tell "same
+    incident, different config" from "same config, new incident" without a
+    live pod."""
+    try:
+        raw = dataclasses.asdict(config)
+    except TypeError:
+        raw = {"repr": repr(config)}
+
+    def _plain(v):
+        if isinstance(v, dict):
+            return {str(k): _plain(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [_plain(x) for x in v]
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            return v
+        return repr(v)
+
+    plain = _plain(raw)
+    digest = hashlib.sha256(
+        json.dumps(plain, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()
+    return {"sha256": digest, "config": plain}
+
+
+#: incident triggers the spooler accepts (closed, like the event catalog)
+TRIGGERS = (
+    "breaker_open", "reset_storm", "pool_exhausted_shed", "deadline_exceeded",
+)
+
+
+class IncidentSpooler:
+    """Bounded on-disk spool of self-contained incident bundles.
+
+    ``trigger(name, context_fn)`` writes ``context_fn()`` + trigger
+    metadata as one JSON file (write-tmp-then-rename — a bundle is never
+    torn) and prunes the oldest files past ``max_bundles``. Per-trigger
+    cooldown keeps a storm from writing a bundle per reset: the FIRST
+    occurrence captures the journal that explains the rest.
+
+    Thread-safe; ``clock`` is injectable so tests exercise the cooldown
+    without sleeping.
+    """
+
+    def __init__(self, spool_dir: str, max_bundles: int = 16,
+                 cooldown_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_bundles < 1:
+            raise ValueError(f"max_bundles={max_bundles}: expected >= 1")
+        self.spool_dir = spool_dir
+        self.max_bundles = int(max_bundles)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._last: Dict[str, float] = {}  # trigger -> last write (clock)
+        self._seq = 0
+
+    # -- write -----------------------------------------------------------
+    def trigger(self, name: str, context_fn: Callable[[], Dict]
+                ) -> Optional[str]:
+        """Spool one bundle for ``name`` unless it fired inside the
+        cooldown. Returns the bundle id, or None when suppressed. A write
+        failure logs and returns None — incident capture must never take
+        the serving path down with it."""
+        if name not in TRIGGERS:
+            raise ValueError(
+                f"unknown incident trigger {name!r}; triggers: {TRIGGERS}"
+            )
+        now = self.clock()
+        with self._lock:
+            last = self._last.get(name)
+            if last is not None and now - last < self.cooldown_s:
+                return None
+            self._last[name] = now
+            self._seq += 1
+            seq = self._seq
+        try:
+            bundle = dict(context_fn())
+            bundle["schema_version"] = SCHEMA_VERSION
+            bundle["trigger"] = name
+            bundle["ts"] = time.time()
+            bid = f"{int(bundle['ts'] * 1e3):013d}_{seq:04d}_{name}"
+            bundle["id"] = bid
+            os.makedirs(self.spool_dir, exist_ok=True)
+            path = os.path.join(self.spool_dir, f"incident_{bid}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(bundle, f, separators=(",", ":"))
+            os.replace(tmp, path)
+            self._prune()
+            return bid
+        except Exception:  # noqa: BLE001 — capture must not fail serving
+            logger.exception("incident bundle write failed (trigger=%s)", name)
+            with self._lock:
+                # a FAILED capture must not burn the cooldown: the next
+                # trigger retries (only un-stamp our own attempt — a
+                # concurrent success keeps its newer stamp)
+                if self._last.get(name) == now:
+                    del self._last[name]
+            return None
+
+    def _prune(self) -> None:
+        files = self._files()
+        while len(files) > self.max_bundles:
+            victim = files.pop(0)  # oldest (ids sort chronologically)
+            try:
+                os.remove(os.path.join(self.spool_dir, victim))
+            except OSError:
+                pass
+
+    def _files(self) -> List[str]:
+        try:
+            names = [
+                n for n in os.listdir(self.spool_dir)
+                if n.startswith("incident_") and n.endswith(".json")
+            ]
+        except OSError:
+            return []
+        return sorted(names)
+
+    # -- read ------------------------------------------------------------
+    def list(self) -> List[Dict]:
+        """Spooled bundles, oldest first: ``{id, trigger, ts, path}``."""
+        out = []
+        for n in self._files():
+            bid = n[len("incident_"):-len(".json")]
+            parts = bid.split("_", 2)
+            out.append({
+                "id": bid,
+                "trigger": parts[2] if len(parts) == 3 else "unknown",
+                "ts": int(parts[0]) / 1e3 if parts[0].isdigit() else 0.0,
+                "path": os.path.join(self.spool_dir, n),
+            })
+        return out
+
+    def load(self, bundle_id: str) -> Optional[Dict]:
+        """One bundle's full JSON (None when unknown). The id is validated
+        against the directory listing — it is never joined into a path
+        straight from the request."""
+        for entry in self.list():
+            if entry["id"] == bundle_id:
+                try:
+                    with open(entry["path"]) as f:
+                        return json.load(f)
+                except (OSError, ValueError):
+                    return None
+        return None

@@ -75,6 +75,9 @@ _RULES: Tuple[Tuple[re.Pattern, str], ...] = tuple(
         # HBM and the fraction of swap-ins hidden under decode
         (r"effective_capacity_x|hide_rate", "higher"),
         # -- lower is better ----------------------------------------------
+        # flight-recorder cost (ISSUE 11): fraction of decode steps/s the
+        # journal costs with the recorder on — growth is a regression
+        (r"overhead_frac", "lower"),
         (r"_ms($|\.|_)|_s$|seconds|_bytes$", "lower"),
     )
 )

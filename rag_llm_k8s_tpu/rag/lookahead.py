@@ -44,6 +44,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from rag_llm_k8s_tpu.obs import flight
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
 from rag_llm_k8s_tpu.resilience import faults
 
@@ -300,6 +301,7 @@ class LookaheadExecutor:
         if not created:
             return fut, False
         self._m_launched.get(trigger, self._m_launched["admission"]).inc()
+        flight.emit("lookahead_launch", trigger=trigger)
         self._queue.put(fut)
         return fut, True
 
@@ -346,14 +348,17 @@ class LookaheadExecutor:
             # measures retrieval time hidden off the critical path, and a
             # ttl-sized error sample would skew the TTL-sizing signal
             self._m_wasted["failed"].inc()
+            flight.emit("lookahead_waste", reason="failed")
             raise fut.error
         self._m_join_wait.observe(time.monotonic() - fut.t_launch)
         self._m_joins["hit" if hit else "late"].inc()
+        flight.emit("lookahead_join", outcome="hit" if hit else "late")
         return fut.result
 
     def note_miss(self) -> None:
         """The serving tail ran retrieval inline (no future existed)."""
         self._m_joins["miss"].inc()
+        flight.emit("lookahead_join", outcome="miss")
 
     def abandon(self, fut: Optional[RetrievalFuture]) -> None:
         """A launched future whose request was shed (admission 429/503):
@@ -406,6 +411,7 @@ class LookaheadExecutor:
             ):
                 del self._session_spec[fut.session_id]
         self._m_wasted[reason].inc()
+        flight.emit("lookahead_waste", reason=reason)
         if fut.resolved():
             self._release(fut)
 
@@ -563,5 +569,6 @@ class LookaheadExecutor:
                 if staging is not None:
                     fut.staging = staging
                     self._m_prestaged.inc()
+                    flight.emit("prestage", trigger=fut.trigger)
             if fut.superseded:
                 self._release(fut)

@@ -28,6 +28,7 @@ import threading
 from contextlib import contextmanager
 from typing import Optional
 
+from rag_llm_k8s_tpu.obs import flight
 from rag_llm_k8s_tpu.resilience.breaker import CircuitBreaker
 from rag_llm_k8s_tpu.resilience.deadline import Deadline, DeadlineExceeded
 
@@ -86,12 +87,22 @@ class AdmissionController:
         # true pressure: the request QUEUES (bounded, deadline-aware)
         # instead of shedding. Tier occupancy, not raw headroom, decides.
         self.reclaimable_hint = None
+        # set by the service (obs/flight.py): called with an incident
+        # trigger name when a shed is post-mortem-worthy — today only
+        # pool-exhaustion sheds, which mean HBM pressure, not tuning
+        self.incident_hook = None
 
     # -- internals -------------------------------------------------------
     def _reject(self, reason: str, status: int, retry_after_s: float):
         fam = self.reject_counter
         if fam is not None:
             fam.labels(reason=reason).inc()
+        flight.emit("shed", reason=reason, status=status)
+        if reason == "pool_exhausted" and self.incident_hook is not None:
+            try:
+                self.incident_hook("pool_exhausted_shed")
+            except Exception:  # noqa: BLE001 — capture must not break the shed
+                pass
         raise AdmissionRejected(reason, status, retry_after_s)
 
     def _acquire(self, deadline: Optional[Deadline]) -> None:

@@ -25,6 +25,8 @@ import threading
 import time
 from typing import Callable, List
 
+from rag_llm_k8s_tpu.obs import flight
+
 __all__ = ["CircuitBreaker"]
 
 
@@ -44,6 +46,14 @@ class CircuitBreaker:
         self.clock = clock
         self._lock = threading.Lock()
         self._events: List[float] = []  # reset timestamps inside the window
+        # observability hooks (set by the service; both optional):
+        # on_reset() fires after EVERY recorded reset, on_open() on the
+        # closed→open transition only — the incident spooler's reset-storm
+        # and breaker-flip bundle triggers (obs/flight.py). Invoked OUTSIDE
+        # the breaker's lock: a hook that writes a bundle to disk must not
+        # serialize readiness probes.
+        self.on_reset = None
+        self.on_open = None
 
     def _prune(self, now: float) -> None:
         cutoff = now - self.window_s
@@ -54,7 +64,20 @@ class CircuitBreaker:
         now = self.clock()
         with self._lock:
             self._prune(now)
+            was_open = len(self._events) >= self.threshold
             self._events.append(now)
+            flipped = not was_open and len(self._events) >= self.threshold
+            n = len(self._events)
+        if flipped:
+            flight.emit("breaker_open", resets=n)
+        hooks = ([self.on_open] if flipped else []) + [self.on_reset]
+        for hook in hooks:
+            if hook is None:
+                continue
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — a hook must not break recording
+                pass
 
     @property
     def open(self) -> bool:

@@ -22,6 +22,8 @@ from __future__ import annotations
 import time
 from typing import Callable, Optional
 
+from rag_llm_k8s_tpu.obs import flight
+
 __all__ = ["Deadline", "DeadlineExceeded"]
 
 # stage labels used across the serving path (documented in RESILIENCE.md):
@@ -44,6 +46,10 @@ class DeadlineExceeded(TimeoutError):
         super().__init__(msg)
         self.stage = stage
         self.budget_ms = budget_ms
+        # constructing this exception IS the decision point — every raise
+        # site (HTTP edge, stage boundaries, scheduler eviction sweep)
+        # journals through this one line
+        flight.emit("deadline", stage=stage)
 
 
 class Deadline:

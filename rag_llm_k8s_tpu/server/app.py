@@ -40,6 +40,7 @@ from rag_llm_k8s_tpu.engine.encoder import EncoderRunner
 from rag_llm_k8s_tpu.engine.engine import InferenceEngine
 from rag_llm_k8s_tpu.index.store import VectorStore
 from rag_llm_k8s_tpu.obs import devices as obs_devices
+from rag_llm_k8s_tpu.obs import flight as obs_flight
 from rag_llm_k8s_tpu.obs import logging as obs_logging
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
 from rag_llm_k8s_tpu.obs import slo as obs_slo
@@ -163,7 +164,28 @@ class RagService:
         # per-scrape memo for the rag_kv_tier_* callback fan-out (see
         # _pcache_tier_stats); must exist before any scrape can fire
         self._tier_stats_memo = None
+        # engine flight recorder + incident bundles (obs/flight.py): the
+        # journal is process-wide (decision points across the substrate
+        # write to it long before any service exists), so the service only
+        # APPLIES its config and owns the incident spool
+        fl = getattr(config, "flight", None)
+        if fl is not None:
+            obs_flight.configure(enabled=fl.enabled, capacity=fl.capacity)
+        self.incidents = (
+            obs_flight.IncidentSpooler(
+                fl.spool_dir, fl.spool_max, fl.cooldown_s
+            )
+            if fl is not None else None
+        )
         self._init_observability()
+        # incident triggers (obs/flight.py): the breaker flip and the
+        # reset storm snapshot the journal that explains them; the
+        # pool-exhaustion shed fires from the admission gate, and deadline
+        # expiry from the HTTP edge (WsgiApp.ep_generate). All hooks run
+        # outside the breaker/gate locks and never propagate.
+        self.breaker.on_open = lambda: self.record_incident("breaker_open")
+        self.breaker.on_reset = self._maybe_reset_storm
+        self.admission.incident_hook = self.record_incident
         self.ready = False
         # per-stage in-flight counters, fed to the coalescers as
         # ``pending_hint``: each batching stage stops waiting out its window
@@ -308,6 +330,14 @@ class RagService:
                 registry=self.metrics,
             )
             self.lookahead.join_timeout_counter = self._m_join_timeouts
+
+    @property
+    def flight(self):
+        """The LIVE process recorder, read at use time — a later service's
+        ``configure(capacity=...)`` rebuilds the singleton, and a captured
+        instance would hand timelines/bundles a dead, frozen ring (the
+        same rule the ``rag_flight_events_total`` callback follows)."""
+        return obs_flight.recorder()
 
     # -- observability ---------------------------------------------------
     def _init_observability(self) -> None:
@@ -522,6 +552,23 @@ class RagService:
             "engine resets inside the breaker window right now",
             fn=lambda: float(self.breaker.recent_resets()),
         )
+        # engine flight recorder (obs/flight.py): journal volume + spooled
+        # post-mortem bundles. The counter reads the PROCESS recorder live
+        # (never a captured instance — configure() can rebuild the ring).
+        reg.counter(
+            "rag_flight_events_total",
+            "events appended to the flight journal (ring-bounded; the "
+            "counter keeps growing past the ring)",
+            fn=lambda: float(obs_flight.recorder().events_emitted),
+        )
+        self._m_incidents = reg.labeled_counter(
+            "rag_incident_bundles_total",
+            "incident bundles written to the on-disk spool (trigger: "
+            "breaker_open | reset_storm | pool_exhausted_shed | "
+            "deadline_exceeded; cooldown-suppressed repeats not counted)",
+        )
+        for t in obs_flight.TRIGGERS:
+            self._m_incidents.labels(trigger=t)
         # per-device HBM + prefix-cache residency (obs/devices.py): the
         # dashboard view of an eviction storm under HBM pressure
         obs_devices.register_device_gauges(reg, self._prefix_bytes_by_device)
@@ -606,6 +653,43 @@ class RagService:
                 for k, v in pcache.tier_stats().items():
                     out[k] = out.get(k, 0.0) + v
         return out
+
+    # -- incident bundles (obs/flight.py) --------------------------------
+    def _maybe_reset_storm(self) -> None:
+        """Breaker reset hook: the SECOND reset inside the window is the
+        storm signal (one reset is routine, self-healing recovery) — the
+        bundle captures the journal while the storm's causal prefix is
+        still in the ring, before the breaker even flips."""
+        if self.breaker.recent_resets() >= 2:
+            self.record_incident("reset_storm")
+
+    def record_incident(self, trigger: str) -> Optional[str]:
+        """Spool one self-contained incident bundle: the recent journal,
+        the full metrics snapshot, a config fingerprint, and the trace
+        ring — everything a post-mortem needs with no live pod. Returns
+        the bundle id (None when cooldown-suppressed / spooling is off)."""
+        spool = self.incidents
+        if spool is None:
+            return None
+
+        def _ctx():
+            return {
+                "journal": self.flight.snapshot(),
+                "metrics": self.metrics.snapshot(),
+                "config_fingerprint": obs_flight.config_fingerprint(
+                    self.config
+                ),
+                "traces": self.traces.list(32),
+                "meta": {
+                    "version": _package_version(),
+                    "engine_mode": _engine_mode(self.scheduler),
+                },
+            }
+
+        bid = spool.trigger(trigger, _ctx)
+        if bid is not None:
+            self._m_incidents.labels(trigger=trigger).inc()
+        return bid
 
     def _pool_retier(self) -> None:
         """Cache→pool tier mirror (PrefixCache.on_retier): re-tag every
@@ -1350,11 +1434,17 @@ class RagService:
         self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
         self.metrics.inc("query_decode_tokens", len(out_ids))
         self._observe_request(timings)
-        return self._finish({
+        resp = {
             "generated_text": extract_answer(completion),
             "context": context,
             "timings": {k: round(v, 2) for k, v in timings.items()},
-        }, notes)
+        }
+        if "request_id" in gen_info:
+            # continuous serving: the scheduler id keying this request's
+            # flight-journal lifecycle (GET /debug/timeline/<id>; also
+            # what {"timeline": true} resolves inline)
+            resp["request_id"] = int(gen_info["request_id"])
+        return self._finish(resp, notes)
 
     def _prefix_enabled(self) -> bool:
         """KV prefix cache applicability (engine/prefix_cache.py)."""
@@ -1847,6 +1937,10 @@ class WsgiApp:
                 Rule("/debug/traces", endpoint="debug_traces", methods=["GET"]),
                 Rule("/debug/faults", endpoint="debug_faults",
                      methods=["GET", "POST"]),
+                Rule("/debug/timeline/<int:rid>", endpoint="debug_timeline",
+                     methods=["GET"]),
+                Rule("/debug/incidents", endpoint="debug_incidents",
+                     methods=["GET"]),
             ]
         )
         # background xprof capture state (/profile {"seconds": N})
@@ -1857,6 +1951,24 @@ class WsgiApp:
     def _jsonify(self, payload, status: int = 200):
         return self._Response(
             self._json.dumps(payload), status=status, mimetype="application/json"
+        )
+
+    def _debug_enabled(self) -> bool:
+        """ONE armed-state contract for every ``/debug/*`` route: 403
+        unless the process started with ``TPU_RAG_FAULTS`` set (the chaos
+        harness) or ``TPU_RAG_DEBUG=1`` (read-only debug surface). The
+        faults endpoint keeps its STRICTER own gate on top — TPU_RAG_DEBUG
+        must never make a pod remotely fault-armable."""
+        fl = getattr(self.service.config, "flight", None)
+        return faults.endpoint_enabled() or bool(
+            fl is not None and fl.debug_endpoints
+        )
+
+    def _debug_forbidden(self):
+        return self._jsonify(
+            {"error": "debug endpoints disabled "
+                      "(set TPU_RAG_FAULTS or TPU_RAG_DEBUG)"},
+            403,
         )
 
     def _request_deadline(self, data, headers):
@@ -1965,6 +2077,14 @@ class WsgiApp:
                 if data.get("trace"):
                     body = dict(body)
                     body["trace"] = tree
+                if data.get("timeline") and body.get("request_id") is not None:
+                    # flight-journal opt-in: the request's own lifecycle
+                    # chain rides home inline (continuous serving — other
+                    # paths carry no scheduler id and return no timeline)
+                    body = dict(body)
+                    body["timeline"] = self.service.flight.timeline(
+                        body["request_id"]
+                    )
                 resp = self._jsonify(body)
         except AdmissionRejected as e:
             if la is not None:
@@ -1992,6 +2112,9 @@ class WsgiApp:
                 # the no-lookahead path are unaffected)
                 la.abandon(launched_fut)
             status = 504
+            # post-mortem capture: the journal still holds the causal
+            # chain that spent this request's budget (cooldown-bounded)
+            self.service.record_incident("deadline_exceeded")
             resp = self._jsonify(
                 {"error": str(e), "stage": e.stage}, 504
             )
@@ -2091,10 +2214,55 @@ class WsgiApp:
             return self._jsonify({"error": str(e)}, 500)
 
     def ep_debug_traces(self, request):
-        """Recent request span trees from the in-memory ring buffer."""
+        """Recent request span trees from the in-memory ring buffer.
+        Same 403-unless-armed contract as every ``/debug`` route."""
+        if not self._debug_enabled():
+            return self._debug_forbidden()
         try:
             limit = request.args.get("limit", type=int)
             return self._jsonify({"traces": self.service.traces.list(limit)})
+        except Exception as e:  # noqa: BLE001
+            return self._jsonify({"error": str(e)}, 500)
+
+    def ep_debug_timeline(self, request, rid: int = 0):
+        """One request's flight-journal lifecycle: the ordered event chain
+        (admit → windows → eos/evict/preempt/resubmit → complete) with
+        inter-event deltas, keyed by the scheduler request id the
+        ``/generate`` response carries as ``request_id``."""
+        if not self._debug_enabled():
+            return self._debug_forbidden()
+        try:
+            tl = self.service.flight.timeline(int(rid))
+            if not tl["events"]:
+                return self._jsonify(
+                    {"error": f"no journaled events for request {rid} "
+                              "(completed past the ring, or never admitted)"},
+                    404,
+                )
+            return self._jsonify(tl)
+        except Exception as e:  # noqa: BLE001
+            return self._jsonify({"error": str(e)}, 500)
+
+    def ep_debug_incidents(self, request):
+        """The incident-bundle spool: ``GET /debug/incidents`` lists
+        bundles ({id, trigger, ts, path}), ``?id=<bundle_id>`` returns one
+        bundle's full self-contained JSON (journal + metrics + config
+        fingerprint + traces — feed it to scripts/flightview.py)."""
+        if not self._debug_enabled():
+            return self._debug_forbidden()
+        try:
+            spool = self.service.incidents
+            if spool is None:
+                return self._jsonify({"incidents": []})
+            bid = request.args.get("id")
+            if bid:
+                bundle = spool.load(bid)
+                if bundle is None:
+                    return self._jsonify(
+                        {"error": f"no incident bundle {bid!r}"}, 404
+                    )
+                return self._jsonify(bundle)
+            return self._jsonify({"incidents": spool.list()})
         except Exception as e:  # noqa: BLE001
             return self._jsonify({"error": str(e)}, 500)
 
@@ -2221,8 +2389,8 @@ class WsgiApp:
         request = self._Request(environ)
         adapter = self.url_map.bind_to_environ(environ)
         try:
-            endpoint, _ = adapter.match()
-            response = getattr(self, f"ep_{endpoint}")(request)
+            endpoint, args = adapter.match()
+            response = getattr(self, f"ep_{endpoint}")(request, **args)
         except self._HTTPException as e:
             response = e
         return response(environ, start_response)

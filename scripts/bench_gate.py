@@ -58,6 +58,10 @@ REQUIRED_KEYS = (
     # fixed HBM, tiered vs hot-only; acceptance ≥ 3) — a dropped leg must
     # never read as "tiering capacity unjudged"
     "kv_tiering.effective_capacity_x",
+    # ISSUE 11: the flight recorder's measured cost (recorder-on vs -off
+    # B=8 continuous decode; acceptance ≤ 2%) — the recorder is ON by
+    # default, so its overhead may never go unjudged in a bench round
+    "flight_overhead.overhead_frac",
 )
 
 

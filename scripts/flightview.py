@@ -1,0 +1,190 @@
+"""flightview — offline renderer for flight-recorder bundles and journals.
+
+Turns an incident bundle (``GET /debug/incidents?id=...``, or a file
+copied off a pod's spool directory) — or a bare journal dump — into:
+
+- **per-request lifecycle timelines**: each request's ordered event chain
+  (admit → sync windows → eos/preempt/evict/resubmit → complete) with
+  inter-event deltas, as ASCII or JSON;
+- **a scheduler-occupancy summary**: windows observed, active-row
+  distribution, rows completed, resets/preemptions/sheds in the window
+  the journal covers.
+
+No live pod, no jax, no third-party deps — a bundle is self-contained by
+contract (docs/OBSERVABILITY.md "Engine flight recorder").
+
+Usage:
+    python scripts/flightview.py BUNDLE.json            # ASCII render
+    python scripts/flightview.py BUNDLE.json --json     # structured form
+    python scripts/flightview.py BUNDLE.json --request 7
+
+Input shapes accepted: a full incident bundle (``{"journal": [...],
+"trigger": ..., ...}``), a journal-only dump (``{"journal": [...]}``), or
+a plain JSON list of events. Events newer than this tool's known
+``schema_version`` are refused loudly rather than misread.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+# keep in sync with rag_llm_k8s_tpu/obs/flight.py — flightview must run
+# standalone on a laptop holding nothing but the bundle file, so the
+# constant is duplicated here ON PURPOSE (the round-trip smoke in
+# tests/test_flight.py fails if the two drift apart)
+SCHEMA_VERSION = 1
+
+
+def load_events(doc) -> List[Dict]:
+    """Extract the event list from any accepted input shape."""
+    if isinstance(doc, list):
+        events = doc
+    elif isinstance(doc, dict):
+        ver = doc.get("schema_version", SCHEMA_VERSION)
+        if int(ver) > SCHEMA_VERSION:
+            raise SystemExit(
+                f"flightview: bundle schema_version {ver} is newer than "
+                f"this tool understands ({SCHEMA_VERSION}) — update the repo"
+            )
+        events = doc.get("journal", [])
+    else:
+        raise SystemExit("flightview: unrecognized input shape")
+    return sorted(events, key=lambda e: e.get("seq", 0))
+
+
+def _attrs(e: Dict) -> Dict:
+    return {
+        k: v for k, v in e.items() if k not in ("seq", "t", "type", "rid")
+    }
+
+
+def build_view(events: List[Dict],
+               request_id: Optional[int] = None) -> Dict:
+    """The structured form: per-request timelines + occupancy summary."""
+    requests: Dict[int, List[Dict]] = {}
+    t0 = events[0]["t"] if events else 0.0
+    for e in events:
+        rid = e.get("rid")
+        if rid is None or (request_id is not None and rid != request_id):
+            continue
+        requests.setdefault(int(rid), []).append(e)
+
+    timelines = {}
+    for rid, evs in sorted(requests.items()):
+        base = evs[0]["t"]
+        prev = base
+        rows = []
+        for e in evs:
+            rows.append({
+                "seq": e.get("seq"),
+                "type": e["type"],
+                "t_ms": round((e["t"] - base) * 1e3, 3),
+                "dt_ms": round((e["t"] - prev) * 1e3, 3),
+                "attrs": _attrs(e),
+            })
+            prev = e["t"]
+        types = [r["type"] for r in rows]
+        timelines[str(rid)] = {
+            "events": rows,
+            "complete": "complete" in types,
+            # only real reset recoveries: a preempt_resume is scheduled
+            # backpressure (no reset happened) and a gave_up is the one
+            # case the client did NOT survive
+            "resets_survived": sum(
+                1 for r in rows
+                if r["type"] == "resubmit"
+                and r["attrs"].get("outcome") == "resubmitted"
+            ),
+            "span_ms": round((evs[-1]["t"] - base) * 1e3, 3),
+        }
+
+    windows = [e for e in events if e["type"] == "sync_window_open"]
+    active = [int(e.get("active", 0)) for e in windows]
+    closes = [e for e in events if e["type"] == "sync_window_close"]
+    occupancy = {
+        "windows": len(windows),
+        "active_mean": round(sum(active) / len(active), 2) if active else 0.0,
+        "active_max": max(active) if active else 0,
+        "rows_done": sum(int(e.get("done", 0)) for e in closes),
+        "resets": sum(1 for e in events if e["type"] == "reset"),
+        "preemptions": sum(1 for e in events if e["type"] == "preempt"),
+        "sheds": sum(1 for e in events if e["type"] == "shed"),
+        "deadline_expiries": sum(
+            1 for e in events if e["type"] == "deadline"
+        ),
+        "journal_span_ms": round(
+            (events[-1]["t"] - t0) * 1e3, 3
+        ) if events else 0.0,
+    }
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "requests": timelines,
+        "occupancy": occupancy,
+    }
+
+
+def render_ascii(view: Dict, meta: Optional[Dict] = None) -> str:
+    lines: List[str] = []
+    if meta:
+        lines.append(
+            f"incident {meta.get('id', '?')}  trigger={meta.get('trigger')}"
+            f"  ts={meta.get('ts')}"
+        )
+        lines.append("")
+    for rid, tl in view["requests"].items():
+        status = "complete" if tl["complete"] else "INCOMPLETE"
+        lines.append(
+            f"request {rid}  [{status}  span={tl['span_ms']:.1f}ms"
+            f"  resets_survived={tl['resets_survived']}]"
+        )
+        for r in tl["events"]:
+            attrs = " ".join(f"{k}={v}" for k, v in r["attrs"].items())
+            lines.append(
+                f"  +{r['t_ms']:>10.3f}ms  (Δ{r['dt_ms']:>9.3f})  "
+                f"{r['type']:<18} {attrs}"
+            )
+        lines.append("")
+    occ = view["occupancy"]
+    lines.append("scheduler occupancy")
+    lines.append(
+        f"  windows={occ['windows']}  active mean={occ['active_mean']}"
+        f" max={occ['active_max']}  rows done={occ['rows_done']}"
+    )
+    lines.append(
+        f"  resets={occ['resets']}  preemptions={occ['preemptions']}"
+        f"  sheds={occ['sheds']}  deadline expiries="
+        f"{occ['deadline_expiries']}  journal span="
+        f"{occ['journal_span_ms']:.1f}ms"
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("bundle", help="incident bundle / journal dump (JSON)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the structured view instead of ASCII")
+    ap.add_argument("--request", type=int, default=None,
+                    help="render only this request id's lifecycle")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.bundle) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"flightview: cannot read {args.bundle}: {e}", file=sys.stderr)
+        return 2
+    events = load_events(doc)
+    view = build_view(events, request_id=args.request)
+    if args.as_json:
+        print(json.dumps(view, indent=1))
+    else:
+        meta = doc if isinstance(doc, dict) and "trigger" in doc else None
+        print(render_ascii(view, meta))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

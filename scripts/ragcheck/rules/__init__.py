@@ -6,6 +6,7 @@ from scripts.ragcheck.rules.sharding_contract import ShardingContractRule
 from scripts.ragcheck.rules.config_drift import ConfigDriftRule
 from scripts.ragcheck.rules.fault_sites import FaultSiteRegistryRule
 from scripts.ragcheck.rules.metric_drift import MetricDriftRule
+from scripts.ragcheck.rules.event_registry import EventRegistryRule
 
 ALL_RULES = [
     LockDisciplineRule,
@@ -14,6 +15,7 @@ ALL_RULES = [
     ConfigDriftRule,
     FaultSiteRegistryRule,
     MetricDriftRule,
+    EventRegistryRule,
 ]
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,166 @@
+"""EVENT-REGISTRY: the flight-event catalog is closed, emitted, and doc'd.
+
+``obs/flight.EVENTS`` is deliberately closed — a typo'd event name would
+journal nothing, and the lifecycle timeline it should have appeared in
+reads as "this never happened". The runtime enforces that for names that
+REACH ``emit`` (unknown types raise), but nothing enforced the other
+directions: a catalog entry no emit site ever produces is an event type
+wearing a timeline's name with nothing behind it, and an undocumented one
+is a journal field nobody can read in a post-mortem. Mirrors
+FAULT-SITE-REGISTRY three ways:
+
+1. every string literal passed to ``flight.emit(...)`` across the package
+   (and tests/) is a member of ``EVENTS``;
+2. every ``EVENTS`` entry is emitted by at least one ``flight.emit`` call
+   site in the package;
+3. every ``EVENTS`` entry appears BACKTICKED in docs/OBSERVABILITY.md (a
+   bare prose word that happens to match a short event name must not
+   count) — and a tree that declares events while the doc is missing
+   fails loudly instead of going vacuously green.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from scripts.ragcheck.core import Finding, Repo
+
+FLIGHT_MODULE = "rag_llm_k8s_tpu/obs/flight.py"
+EVENTS_DOC = "docs/OBSERVABILITY.md"
+
+
+def _declared_events(repo: Repo) -> Tuple[Optional[int], List[str]]:
+    sf = repo.get(FLIGHT_MODULE)
+    if sf is None or sf.tree is None:
+        return None, []
+    for node in ast.walk(sf.tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "EVENTS":
+                if not isinstance(node.value, ast.Dict):
+                    return node.lineno, []
+                keys = [
+                    k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                ]
+                return node.lineno, keys
+    return None, []
+
+
+def _is_flight_emit(call: ast.Call) -> bool:
+    """Match the one sanctioned call shape, ``flight.emit(...)`` (any
+    aliasing of the module keeps the terminal attribute) — a bare
+    ``emit(...)`` could be anything and is not the package idiom."""
+    f = call.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr == "emit"
+        and isinstance(f.value, ast.Name)
+        and f.value.id in ("flight", "obs_flight")
+    )
+
+
+def _event_literal(call: ast.Call) -> Optional[ast.Constant]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "etype" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value
+    return None
+
+
+class EventRegistryRule:
+    id = "EVENT-REGISTRY"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        line, events = _declared_events(repo)
+        if line is None:
+            return  # no flight module in this tree (fixture repos)
+        event_set = set(events)
+
+        emitted: set = set()
+        scan = list(repo.scan_files) + repo.glob_py("tests")
+        for sf in scan:
+            if sf.tree is None or sf.path == FLIGHT_MODULE:
+                continue
+            in_package = not sf.path.startswith("tests/")
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call) or not _is_flight_emit(node):
+                    continue
+                lit = _event_literal(node)
+                if lit is None:
+                    continue
+                if lit.value in event_set:
+                    if in_package:
+                        emitted.add(lit.value)
+                    continue
+                yield Finding(
+                    rule=self.id,
+                    path=sf.path,
+                    line=node.lineno,
+                    message=(
+                        f"flight.emit({lit.value!r}) names an event not in "
+                        "obs/flight.EVENTS — the catalog is closed; add the "
+                        "entry (and its doc row) or fix the name"
+                    ),
+                    key=f"unknown-event:{lit.value}",
+                )
+
+        # 2. every catalog entry has a live emit site in the PACKAGE
+        for ev in events:
+            if ev not in emitted:
+                yield Finding(
+                    rule=self.id,
+                    path=FLIGHT_MODULE,
+                    line=line,
+                    message=(
+                        f"flight event {ev!r} is in EVENTS but no "
+                        "flight.emit site in the package produces it — a "
+                        "never-emitted event is a timeline that can't "
+                        "happen; instrument the decision point or retire "
+                        "the entry"
+                    ),
+                    key=f"unemitted-event:{ev}",
+                )
+
+        # 3. every catalog entry is documented (and the doc must exist
+        # while events do — a renamed doc must not retire the gate)
+        doc = repo.get(EVENTS_DOC)
+        if doc is None:
+            if events:
+                yield Finding(
+                    rule=self.id,
+                    path=FLIGHT_MODULE,
+                    line=line,
+                    message=(
+                        f"{EVENTS_DOC} is missing while flight.EVENTS "
+                        "declares entries — the event table has nowhere "
+                        "to live; restore the doc"
+                    ),
+                    key="events-doc-missing",
+                )
+            return
+        for ev in events:
+            # the BACKTICKED form only: a prose word that happens to match
+            # a short event name ("reset", "complete") must not count as
+            # documentation
+            if f"`{ev}`" in doc.text:
+                continue
+            yield Finding(
+                rule=self.id,
+                path=EVENTS_DOC,
+                line=1,
+                message=(
+                    f"flight event {ev!r} has no row in {EVENTS_DOC} — an "
+                    "undocumented journal event is unreadable in a "
+                    "post-mortem; add it to the event-type table"
+                ),
+                key=f"undocumented-event:{ev}",
+            )

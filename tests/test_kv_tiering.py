@@ -409,6 +409,80 @@ class TestTierTransitions:
             ex.shutdown()
 
 
+class TestAssembledMemoEviction:
+    """Satellite (the open item carried since the tiering PR): the
+    assembled-memo cache evicts TIER-AWARE — a memo whose chain's coldest
+    segment demoted gives its buffer back first, even when a hot chain's
+    memo is older in LRU order."""
+
+    def _tiered_cache(self, entries=2):
+        cfg = PrefixCacheConfig(
+            enabled=True, max_prefix_tokens=4096, segment_buckets=(64,),
+            suffix_buckets=(128,), hbm_budget_mb=64,
+            assembled_cache_entries=entries,
+        )
+        t = {"now": 0.0}
+        c = PrefixCache(
+            cfg, _StubEngine(),
+            tiering=dataclasses.replace(TIERING, half_life_s=10.0),
+        )
+        c.hotness = HotnessTracker(10.0, clock=lambda: t["now"])
+        return c, t
+
+    HOT = [("head", list(range(8))), ("chunk:hot", list(range(16)))]
+    COLD = [("head", list(range(8))), ("chunk:cold", list(range(16)))]
+    NEW = [("head", list(range(8))), ("chunk:new", list(range(16)))]
+
+    @staticmethod
+    def _chains(c):
+        return {ak[0] for ak in c._assembled}
+
+    def test_cold_chain_memo_evicts_before_older_hot_chain(self):
+        c, t = self._tiered_cache(entries=2)
+        c.prefix_for(self.HOT)   # the OLDER memo (pure LRU's victim)
+        c.prefix_for(self.COLD)
+        assert len(c._assembled) == 2
+        t["now"] = 60.0  # 6 half-lives: every score deep in the cold band
+        for k, _ in self.HOT:
+            c.hotness.touch(k)  # re-heat ONLY the hot chain's members
+        # the third resolve trips the count cap: tier-aware eviction must
+        # take the cold chain's memo, not the older hot one
+        c.prefix_for(self.NEW)
+        chains = self._chains(c)
+        assert ("head", "chunk:hot") in chains
+        assert ("head", "chunk:cold") not in chains
+        assert ("head", "chunk:new") in chains
+
+    def test_untiered_cache_keeps_pure_lru(self):
+        cfg = PrefixCacheConfig(
+            enabled=True, max_prefix_tokens=4096, segment_buckets=(64,),
+            suffix_buckets=(128,), hbm_budget_mb=64,
+            assembled_cache_entries=2,
+        )
+        c = PrefixCache(cfg, _StubEngine(), tiering=None)
+        c.prefix_for(self.HOT)
+        c.prefix_for(self.COLD)
+        c.prefix_for(self.NEW)
+        chains = self._chains(c)
+        # no tiers: the oldest memo goes, exactly as before
+        assert ("head", "chunk:hot") not in chains
+        assert ("head", "chunk:cold") in chains
+
+    def test_budget_sweep_consumes_the_same_tier_order(self):
+        """``_enforce_budget_locked`` consumes ``_assembled_evict_order``
+        — pin that the order puts the cold chain's memo first and the
+        re-heated hot chain's last, LRU notwithstanding."""
+        c, t = self._tiered_cache(entries=8)
+        c.prefix_for(self.HOT)   # older in LRU order
+        c.prefix_for(self.COLD)
+        t["now"] = 60.0
+        for k, _ in self.HOT:
+            c.hotness.touch(k)
+        order = [ak[0] for ak in c._assembled_evict_order()]
+        assert order[0] == ("head", "chunk:cold")
+        assert order[-1] == ("head", "chunk:hot")
+
+
 class TestConcurrency:
     def test_promote_while_serving_stays_consistent(self):
         """Resolves racing retier demotions/promotions: every resolve must

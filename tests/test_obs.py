@@ -360,8 +360,15 @@ class TestTracedGenerate:
         body = client.post("/query", json={"prompt": "again"}).get_json()
         assert "trace" not in body
 
-    def test_debug_traces_ring(self, served):
+    def test_debug_traces_ring(self, served, monkeypatch):
+        # /debug/traces follows the uniform 403-unless-armed contract
+        # since the flight-recorder round (tests/test_flight.py pins the
+        # contract across every /debug route; arming here exercises the
+        # served payload)
         svc, client = served
+        monkeypatch.delenv("TPU_RAG_FAULTS", raising=False)
+        assert client.get("/debug/traces").status_code == 403
+        monkeypatch.setenv("TPU_RAG_FAULTS", "1")
         n_before = len(svc.traces)
         client.post("/query", json={"prompt": "ring me"})
         r = client.get("/debug/traces")

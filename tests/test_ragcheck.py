@@ -21,6 +21,7 @@ if str(REPO_ROOT) not in sys.path:
 
 from scripts.ragcheck import core  # noqa: E402
 from scripts.ragcheck.rules.config_drift import ConfigDriftRule  # noqa: E402
+from scripts.ragcheck.rules.event_registry import EventRegistryRule  # noqa: E402
 from scripts.ragcheck.rules.fault_sites import FaultSiteRegistryRule  # noqa: E402
 from scripts.ragcheck.rules.jit_hygiene import JitHygieneRule  # noqa: E402
 from scripts.ragcheck.rules.lock_discipline import LockDisciplineRule  # noqa: E402
@@ -519,6 +520,101 @@ class TestFaultSiteRegistry:
                 def test_both():
                     assert "alpha" and "beta"
                 """,
+        })
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# EVENT-REGISTRY
+# ---------------------------------------------------------------------------
+
+_FLIGHT_FIXTURE = """
+    EVENTS = {
+        "admit": "request admitted",
+        "reset": "engine reset",
+    }
+
+    def emit(etype, request_id=None, **attrs):
+        pass
+"""
+
+_EVENTS_DOC = """
+    # Observability
+
+    | event | meaning |
+    |---|---|
+    | `admit` | request admitted |
+    | `reset` | engine reset |
+"""
+
+
+class TestEventRegistry:
+    def test_flags_unknown_and_unemitted_events(self, tmp_path):
+        fs = run_rule(tmp_path, EventRegistryRule, {
+            "rag_llm_k8s_tpu/obs/flight.py": _FLIGHT_FIXTURE,
+            "rag_llm_k8s_tpu/engine/thing.py": """
+                from rag_llm_k8s_tpu.obs import flight
+                def hot_path():
+                    flight.emit("admitt", slot=1)  # typo: not in EVENTS
+                    flight.emit("admit", slot=1)
+                """,
+            "docs/OBSERVABILITY.md": _EVENTS_DOC,
+        })
+        # "reset" is declared + documented but nothing emits it
+        assert keys(fs) == {"unknown-event:admitt", "unemitted-event:reset"}
+
+    def test_test_file_emits_do_not_satisfy_coverage(self, tmp_path):
+        # a test calling flight.emit("reset") validates the literal but
+        # does NOT count as the package instrumenting the decision point
+        fs = run_rule(tmp_path, EventRegistryRule, {
+            "rag_llm_k8s_tpu/obs/flight.py": _FLIGHT_FIXTURE,
+            "rag_llm_k8s_tpu/engine/thing.py": """
+                from rag_llm_k8s_tpu.obs import flight
+                def hot_path():
+                    flight.emit("admit", slot=1)
+                """,
+            "tests/test_thing.py": """
+                from rag_llm_k8s_tpu.obs import flight
+                def test_reset():
+                    flight.emit("reset")
+                """,
+            "docs/OBSERVABILITY.md": _EVENTS_DOC,
+        })
+        assert keys(fs) == {"unemitted-event:reset"}
+
+    def test_flags_undocumented_event_and_missing_doc(self, tmp_path):
+        files = {
+            "rag_llm_k8s_tpu/obs/flight.py": _FLIGHT_FIXTURE,
+            "rag_llm_k8s_tpu/engine/thing.py": """
+                from rag_llm_k8s_tpu.obs import flight
+                def hot_path():
+                    flight.emit("admit")
+                    flight.emit("reset")
+                """,
+            # the doc table documents only one of the two; "reset" appears
+            # in PROSE (unbackticked) and must not count
+            "docs/OBSERVABILITY.md": """
+                | `admit` | request admitted |
+
+                After a reset the engine rebuilds its state.
+            """,
+        }
+        fs = run_rule(tmp_path, EventRegistryRule, files)
+        assert keys(fs) == {"undocumented-event:reset"}
+        del files["docs/OBSERVABILITY.md"]
+        fs = run_rule(tmp_path / "nodoc", EventRegistryRule, files)
+        assert keys(fs) == {"events-doc-missing"}
+
+    def test_compliant_twin_is_silent(self, tmp_path):
+        fs = run_rule(tmp_path, EventRegistryRule, {
+            "rag_llm_k8s_tpu/obs/flight.py": _FLIGHT_FIXTURE,
+            "rag_llm_k8s_tpu/engine/thing.py": """
+                from rag_llm_k8s_tpu.obs import flight
+                def hot_path():
+                    flight.emit("admit", slot=1)
+                    flight.emit("reset")
+                """,
+            "docs/OBSERVABILITY.md": _EVENTS_DOC,
         })
         assert fs == []
 
