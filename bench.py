@@ -1042,6 +1042,147 @@ def measure_kv_tiering() -> dict:
     }
 
 
+def measure_chunk_reuse() -> dict:
+    """Chunk-granular prefix reuse (ISSUE 12 acceptance leg): prefill
+    tokens skipped on a SHUFFLED-COMPOSITION workload — the same chunk set
+    permuted across queries, the RAG pattern exact-chain reuse can never
+    hit past the head.
+
+    Two identical prefix caches (real tiny engine, real prefill work)
+    serve the same query stream — one fixed head + 3 chunks drawn from a
+    6-chunk hot set, order permuted per query:
+
+    - **exact-chain** (`reuse="exact"`): a permuted chain misses on every
+      chunk past the first divergence — the pre-PR behavior.
+    - **chunk** (`reuse="chunk"`): each hot chunk's KV is canonical-once;
+      shifted placements re-rotate K by the RoPE delta and re-prefill only
+      the ``boundary_tokens`` window.
+
+    Acceptance: ``prefill_skip_frac`` ≥ 0.5 on the shuffled stream with
+    spliced-vs-cold last-token logits within the pinned tolerance (0.15,
+    the warm tier's pin). Resolve throughput is reported per policy."""
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rag_llm_k8s_tpu.core.config import (
+        DTypePolicy,
+        EngineConfig,
+        LlamaConfig,
+        PrefixCacheConfig,
+        SamplingConfig,
+    )
+    from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+    from rag_llm_k8s_tpu.engine.prefix_cache import PrefixCache
+    from rag_llm_k8s_tpu.models.llama import (
+        KVCache,
+        init_llama_params,
+        make_kv_cache,
+    )
+
+    fp32 = DTypePolicy.fp32()
+    cfg = LlamaConfig.tiny(vocab_size=128)
+    base = dict(
+        enabled=True, max_prefix_tokens=64, segment_buckets=(16,),
+        suffix_buckets=(16,), hbm_budget_mb=64,
+    )
+    engine = InferenceEngine(
+        cfg,
+        init_llama_params(jax.random.PRNGKey(0), cfg, fp32),
+        sampling=SamplingConfig(do_sample=False, max_new_tokens=4),
+        engine_config=EngineConfig(
+            prompt_buckets=(64,), max_batch_size=2, speculative="off",
+            max_seq_len=128,
+            prefix_cache=PrefixCacheConfig(**base, reuse="chunk",
+                                           boundary_tokens=4,
+                                           chunk_hot_min=0.0),
+        ),
+        dtypes=fp32,
+    )
+    rng = np.random.default_rng(0)
+    head = [int(cfg.bos_token_id)] + list(map(int, rng.integers(3, 120, 15)))
+    chunks = {
+        f"chunk:{i}": list(map(int, rng.integers(3, 120, 16)))
+        for i in range(6)
+    }
+    # shuffled-composition stream: every query draws 3 chunks, permuted
+    orders = list(itertools.permutations(sorted(chunks), 3))
+    rng.shuffle(orders)
+    stream = [
+        [("head", head)] + [(k, chunks[k]) for k in keys]
+        for keys in orders[:24]
+    ]
+
+    def run(policy_cfg):
+        cache = PrefixCache(policy_cfg, engine)
+        t0 = time.monotonic()
+        last = None
+        for segs in stream:
+            last = (segs, cache.prefix_for(segs))
+        dt = time.monotonic() - t0
+        reused, computed = cache.tokens_reused, cache.tokens_computed
+        return reused, computed, dt, last
+
+    chunk_cfg = PrefixCacheConfig(
+        **base, reuse="chunk", boundary_tokens=4, chunk_hot_min=0.0
+    )
+    exact_cfg = PrefixCacheConfig(**base, reuse="exact")
+    c_reused, c_computed, c_dt, (segs, cp) = run(chunk_cfg)
+    e_reused, e_computed, e_dt, _ = run(exact_cfg)
+
+    # quality gate: spliced-vs-cold last-token logits on the final
+    # (shuffled) composition, pinned at the warm tier's 0.15
+    suffix = list(map(int, rng.integers(3, 120, 5)))
+    T, S_suf = 128, 16
+    n = cp.length + len(suffix)
+    cache0 = make_kv_cache(cfg, 1, T, jnp.float32)
+    planes = tuple(
+        jax.lax.dynamic_update_slice(c, b, (0,) * c.ndim)
+        for c, b in zip((cache0.k, cache0.v), cp.planes)
+    )
+    toks = np.zeros((1, S_suf), np.int32)
+    toks[0, : len(suffix)] = suffix
+    pos = (cp.length + jnp.arange(S_suf, dtype=jnp.int32))[None, :]
+    lg_s, _ = engine.model_chunked.apply(
+        {"params": engine.params}, jnp.asarray(toks), pos, KVCache(*planes),
+        jnp.zeros((1,), jnp.int32), jnp.full((1,), n, jnp.int32),
+        jnp.int32(cp.length), logit_index=jnp.int32(len(suffix) - 1),
+    )
+    full = [t for _, seg in segs for t in seg] + suffix
+    cache1 = make_kv_cache(cfg, 1, T, jnp.float32)
+    lg_c, _ = engine.model.apply(
+        {"params": engine.params},
+        jnp.asarray(np.asarray(full, np.int32)[None, :]),
+        jnp.arange(n, dtype=jnp.int32)[None, :], cache1,
+        jnp.zeros((1,), jnp.int32), jnp.full((1,), n, jnp.int32),
+        jnp.int32(0), last_logit_only=True,
+    )
+    tol = float(np.max(np.abs(np.asarray(lg_s[0, -1]) - np.asarray(lg_c[0, -1]))))
+    return {
+        "chunk_reuse": {
+            "queries": len(stream),
+            "chunk_set": len(chunks),
+            # the acceptance headline: prefill tokens skipped / resolved
+            # on the shuffled stream (≥ 0.5 accepted)
+            "prefill_skip_frac": round(
+                c_reused / max(c_reused + c_computed, 1), 3
+            ),
+            "exact_skip_frac": round(
+                e_reused / max(e_reused + e_computed, 1), 3
+            ),
+            "tokens_reused": c_reused,
+            "tokens_computed": c_computed,
+            "resolve_qps": round(len(stream) / max(c_dt, 1e-9), 1),
+            "exact_resolve_qps": round(len(stream) / max(e_dt, 1e-9), 1),
+            "logit_max_err": round(tol, 4),
+            "logit_tol": 0.15,
+            "logit_tol_ok": tol <= 0.15,
+        }
+    }
+
+
 def measure_flight_overhead() -> dict:
     """Flight-recorder overhead (ISSUE 11 acceptance): B=8 continuous
     decode steps/s through the PUBLIC ``engine.step()`` path — the one
@@ -2479,6 +2620,7 @@ def bench_legs(line: dict):
         ("paged_tp", lambda: line.update(measure_paged_tp())),
         ("lookahead_overlap", lambda: line.update(measure_lookahead_overlap())),
         ("kv_tiering", lambda: line.update(measure_kv_tiering())),
+        ("chunk_reuse", lambda: line.update(measure_chunk_reuse())),
         ("flight_overhead", lambda: line.update(measure_flight_overhead())),
         ("query_e2e", lambda: line.update(measure_query_e2e())),
         ("ingest_scale", lambda: line.update(measure_ingest_scale())),

@@ -306,7 +306,33 @@ class PrefixCacheConfig:
     # parity tests pin this). "slot": offset match alone suffices (HA-RAG-
     # style hotness reuse — K/V of layers > 0 carry the old left context,
     # an approximation those systems accept for the prefill savings).
-    reuse: str = "exact"  # "exact" | "slot"
+    # "chunk" (env TPU_RAG_PREFIX_REUSE): chunk-granular reuse via attention
+    # invariance (SIFT, docs/PREFIX_CACHE.md "chunk-granular reuse") — a hot
+    # chunk's KV is computed ONCE at a canonical position and spliced into
+    # any prompt at any offset by a closed-form RoPE re-rotation of the K
+    # planes plus a bounded boundary-correction re-prefill of the chunk's
+    # first ``boundary_tokens`` tokens (where cross-chunk attention actually
+    # differs). Canonical-position, canonical-chain hits stay bit-identical;
+    # shifted splices are tolerance-gated like the warm tier.
+    reuse: str = "exact"  # "exact" | "slot" | "chunk"
+    # chunk-reuse boundary-correction window (env
+    # TPU_RAG_PREFIX_BOUNDARY_TOKENS): the first N tokens of every shifted
+    # spliced chunk are re-prefilled with the TRUE left context — the slots
+    # where attention over the changed composition measurably differs from
+    # the canonical computation. 0 = pure re-rotation (fastest, most drift).
+    boundary_tokens: int = 16
+    # minimum decayed hit-frequency score before a chunk's canonical KV is
+    # spliced at a SHIFTED position (env TPU_RAG_PREFIX_CHUNK_HOT_MIN):
+    # cold/one-shot chunks keep the exact-chain/recompute path — the drift
+    # budget is spent only where the prefill savings recur. The score comes
+    # from the tiering HotnessTracker when tiering is on, else from a
+    # cache-private tracker with the same decay grammar.
+    chunk_hot_min: float = 2.0
+    # bound on per-chunk canonical POOL registrations the paged engine
+    # keeps (env TPU_RAG_PREFIX_CHUNK_POOL_REGS): size it to the hot chunk
+    # set (+1 for the head) or the per-chunk assembly path thrashes —
+    # least-recently-planned registrations evict past the cap
+    chunk_pool_regs: int = 32
     # fully-assembled prefix buffers memoized per (segment-chain, length):
     # a repeated query re-splices nothing — its whole prefix is one device
     # handle. Small count cap (each buffer is max_prefix_tokens wide).
@@ -934,6 +960,55 @@ class AppConfig:
                 engine,
                 prefix_cache=dataclasses.replace(
                     engine.prefix_cache, hbm_budget_mb=mb
+                ),
+            )
+        if "TPU_RAG_PREFIX_REUSE" in env:
+            policy = env["TPU_RAG_PREFIX_REUSE"]
+            if policy not in ("exact", "slot", "chunk"):
+                raise ValueError(
+                    f"TPU_RAG_PREFIX_REUSE={policy!r}: expected "
+                    "'exact', 'slot' or 'chunk'"
+                )
+            engine = dataclasses.replace(
+                engine,
+                prefix_cache=dataclasses.replace(
+                    engine.prefix_cache, reuse=policy
+                ),
+            )
+        if "TPU_RAG_PREFIX_BOUNDARY_TOKENS" in env:
+            bw = int(env["TPU_RAG_PREFIX_BOUNDARY_TOKENS"])
+            if bw < 0:
+                raise ValueError(
+                    f"TPU_RAG_PREFIX_BOUNDARY_TOKENS={bw}: expected >= 0"
+                )
+            engine = dataclasses.replace(
+                engine,
+                prefix_cache=dataclasses.replace(
+                    engine.prefix_cache, boundary_tokens=bw
+                ),
+            )
+        if "TPU_RAG_PREFIX_CHUNK_HOT_MIN" in env:
+            hm = float(env["TPU_RAG_PREFIX_CHUNK_HOT_MIN"])
+            if hm < 0:
+                raise ValueError(
+                    f"TPU_RAG_PREFIX_CHUNK_HOT_MIN={hm}: expected >= 0"
+                )
+            engine = dataclasses.replace(
+                engine,
+                prefix_cache=dataclasses.replace(
+                    engine.prefix_cache, chunk_hot_min=hm
+                ),
+            )
+        if "TPU_RAG_PREFIX_CHUNK_POOL_REGS" in env:
+            cr = int(env["TPU_RAG_PREFIX_CHUNK_POOL_REGS"])
+            if cr < 1:
+                raise ValueError(
+                    f"TPU_RAG_PREFIX_CHUNK_POOL_REGS={cr}: expected >= 1"
+                )
+            engine = dataclasses.replace(
+                engine,
+                prefix_cache=dataclasses.replace(
+                    engine.prefix_cache, chunk_pool_regs=cr
                 ),
             )
         tiering = engine.kv_tiering

@@ -37,6 +37,7 @@ import queue
 import random
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -214,6 +215,21 @@ class ContinuousEngine:
             self._admit_seq = 0
             self._preempted: List[Tuple[int, List[int]]] = []
             self._blocks_at_retire: Dict[int, int] = {}
+            # CHUNK-granular canonical registrations (reuse="chunk"):
+            # seg_key -> (full block ids, canonical logical offset, segment
+            # length, cache-entry creation stamp, tokens-counted flag).
+            # Unlike _prefix_blocks (whole-chain sharing, copy-free), these
+            # are the SOURCE blocks a per-chunk admission re-rotates into
+            # freshly allocated destination blocks at arbitrary order —
+            # content-safe at any position because K is position-shifted in
+            # the copy. Stamp identity ties each registration to the
+            # prefix-cache entry it mirrors, so a rebuilt entry silently
+            # retires the stale registration (plan lookups decline on
+            # mismatch). OrderedDict: plan hits move-to-end, so the cap
+            # (PrefixCacheConfig.chunk_pool_regs) evicts least-recently-
+            # PLANNED, not oldest-inserted.
+            self._chunk_regs: "OrderedDict[str, tuple]" = OrderedDict()
+            self._chunk_reg_tokens = 0
         self.params, fused = maybe_fuse_params(params, engine_config, mesh)
         self.params, quantized = maybe_quantize_params(self.params, engine_config)
         self.model = LlamaModel(
@@ -439,6 +455,9 @@ class ContinuousEngine:
             self._prefix_tier.clear()
             self._reclaimable_blocks = 0
             self._registered_tokens = 0
+            # chunk registrations' blocks went back with kv_pool.reset()
+            self._chunk_regs.clear()
+            self._chunk_reg_tokens = 0
             # pending preemption records describe PRE-reset slots; the reset
             # recovery resubmits every in-flight request itself, so replaying
             # a stale record would double-submit it (duplicate tokens at the
@@ -469,6 +488,10 @@ class ContinuousEngine:
                 fn = self._build_prefill_px_paged(S)  # S carries the suffix bucket
             elif kind == "prefix_scatter":
                 fn = self._build_prefix_scatter(S)  # S carries the buffer width
+            elif kind == "chunk_splice":
+                fn = self._build_chunk_splice(S)  # S carries the block count
+            elif kind == "boundary_px":
+                fn = self._build_boundary_px_paged(S)  # S carries the window
             else:
                 fn = self._build_insert(S, n)
             self._m_compile_events.inc()
@@ -773,6 +796,14 @@ class ContinuousEngine:
             if entry is not None and entry[2] == plen:
                 shared_ids = list(entry[0])
                 self._prefix_uses[key] = self._prefix_uses.get(key, 0) + 1
+        # chunk-granular assembly (reuse="chunk"): when the whole chain has
+        # no shared registration but every chunk has a canonical one, the
+        # block table assembles from per-chunk registrations at arbitrary
+        # order — gather + RoPE-re-rotate into fresh blocks + boundary
+        # re-prefill straight into pool blocks, no splice-buffer scatter
+        plan = None
+        if not shared_ids:
+            plan = self._chunk_splice_plan(prefix)
         covered = len(shared_ids)
         need_total = self.kv_pool.blocks_for(max(total, 1))
         priv = self.kv_pool.alloc(need_total - covered)  # PoolExhausted → caller
@@ -783,13 +814,17 @@ class ContinuousEngine:
         self._device_tables()
 
         # scatter the un-shared prefix slabs (all of them on a miss; just
-        # the partial tail block on a hit) from the splice buffer
+        # the partial tail block on a hit) from the splice buffer — unless
+        # the per-chunk assembly path populates the blocks instead
         nbp = P // bs
         scatter_ids = np.zeros((nbp,), np.int32)
-        for j in range(covered, min(self.kv_pool.blocks_for(plen), nbp)):
-            scatter_ids[j] = ids_all[j]
+        if plan is None:
+            for j in range(covered, min(self.kv_pool.blocks_for(plen), nbp)):
+                scatter_ids[j] = ids_all[j]
         try:
-            if scatter_ids.any():
+            if plan is not None:
+                self._chunk_splice_into_row(row, ids_all, plan)
+            elif scatter_ids.any():
                 self._cache = self._get("prefix_scatter", P, 0)(
                     self._cache, tuple(self._put(p) for p in prefix.planes),
                     self._put(jnp.asarray(scatter_ids)),
@@ -804,14 +839,28 @@ class ContinuousEngine:
             self.reset()
             raise EngineStateLost("prefixed insert failed; engine state reset") from e
 
-        # register a first-seen prefix's full blocks for future sharing
+        # register a first-seen prefix's full blocks for future sharing —
+        # from the scatter path AND the per-chunk assembly (a repeated
+        # permutation must map these blocks copy-free, not re-splice and
+        # re-run its boundary prefills on every admission)
         full_n = plen // bs
         shared_tok = covered * bs  # tokens this row serves from shared blocks
-        if key is not None and not shared_ids and full_n > 0:
+        chain_registered = key is not None and not shared_ids and full_n > 0
+        if chain_registered:
             reg = ids_all[:full_n]
             self.kv_pool.ref(reg)  # the cache's own ref outlives the row
             self._register_prefix(key, reg, plen)
             shared_tok = full_n * bs  # now registration-counted, not row-counted
+        if plan is None and not shared_ids:
+            # block-aligned exact spans become per-chunk canonical copies
+            # (reuse="chunk" metadata only; no-op otherwise). Scatter
+            # admissions ONLY: on a chain hit the blocks hold an EARLIER
+            # admission's content — this resolve's span exactness/stamps
+            # do not describe those bytes, and registering them could
+            # canonicalize a re-rotated copy (compounding drift)
+            self._register_chunks_from_scatter(
+                prefix, ids_all, chain_registered=chain_registered
+            )
 
         tok0 = int(np.asarray(tok0s)[0])
         self._kv_len = self._kv_len.at[row].set(total)
@@ -1467,6 +1516,243 @@ class ContinuousEngine:
             jax.ShapeDtypeStruct((1, 2), jnp.uint32, sharding=rep),
         ).compile()
 
+    def _build_chunk_splice(self, nb: int):
+        """Per-chunk paged splice (chunk-granular prefix reuse): copy ``nb``
+        physical blocks' K/V from a chunk's canonical registration into
+        freshly allocated destination blocks, position-shifting K by the
+        closed-form RoPE ``delta`` rotation in the same pass (the int8
+        arena goes dequant → rotate → requant with per-vector scale
+        recomputation). V is position-free and copies untouched. One
+        executable per block count, like every other admission-path op."""
+        from rag_llm_k8s_tpu.models.llama import rope_frequencies
+        from rag_llm_k8s_tpu.ops.attention import (
+            rope_rerotate,
+            rope_rerotate_q8,
+        )
+
+        inv = rope_frequencies(self.config)
+        kv_quant = self.kv_quant
+        i32 = jnp.int32
+
+        def splice(arena, src, dst, delta):
+            k, v = arena[0], arena[1]
+            ks = jnp.take(k, src, axis=1)  # [L, nb, K, bs, hd]
+            vs = jnp.take(v, src, axis=1)
+            if kv_quant == "int8":
+                ksc = jnp.take(arena[2], src, axis=1)  # [L, nb, K, bs]
+                vsc = jnp.take(arena[3], src, axis=1)
+                rk, rks = rope_rerotate_q8(ks, ksc, delta, inv)
+                return (
+                    k.at[:, dst].set(rk),
+                    v.at[:, dst].set(vs),
+                    arena[2].at[:, dst].set(rks),
+                    arena[3].at[:, dst].set(vsc),
+                )
+            rk = rope_rerotate(ks, delta, inv)
+            return (k.at[:, dst].set(rk), v.at[:, dst].set(vs))
+
+        rep = self.mesh.replicated if self.mesh is not None else None
+        out_shardings = (
+            self._arena_shardings() if self.mesh is not None else None
+        )
+        return jax.jit(
+            splice, donate_argnums=(0,), out_shardings=out_shardings
+        ).lower(
+            self._arena_avals(),
+            jax.ShapeDtypeStruct((nb,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((nb,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((), i32, sharding=rep),
+        ).compile()
+
+    def _build_boundary_px_paged(self, W: int):
+        """Boundary-correction re-prefill straight into pool blocks: the
+        first ``W`` tokens of a spliced chunk recompute THROUGH the model
+        with the true left context (offset causality over the row's table
+        at logical ``woff``; ``kv_len = woff + W`` hides everything to the
+        right), their fresh K/V scattering into the already-mapped
+        destination blocks. No sampling, no logits consumed — exactly the
+        width is written, so the spliced tail beyond the window survives
+        (unlike the right-padded suffix prefill, whose pad writes land
+        outside every kv window)."""
+        model = self.model_chunked_paged
+        kv_quant = self.kv_quant
+        i32 = jnp.int32
+        from rag_llm_k8s_tpu.models.llama import KVCache
+
+        def bfix(params, arena, row_table, toks, woff):
+            positions = (woff + jnp.arange(W, dtype=i32))[None, :]
+            kv_len = jnp.broadcast_to(woff + W, (1,)).astype(i32)
+            _, cache = model.apply(
+                {"params": params}, toks, positions, KVCache(*arena),
+                jnp.zeros((1,), i32), kv_len,
+                jnp.broadcast_to(woff, (1,)),
+                logit_index=jnp.int32(0), block_tables=row_table,
+            )
+            return (
+                (cache.k, cache.v, cache.k_scale, cache.v_scale)
+                if kv_quant == "int8" else (cache.k, cache.v)
+            )
+
+        rep = self.mesh.replicated if self.mesh is not None else None
+        out_shardings = (
+            self._arena_shardings() if self.mesh is not None else None
+        )
+        return jax.jit(
+            bfix, donate_argnums=(1,), out_shardings=out_shardings
+        ).lower(
+            param_avals(self.params),
+            self._arena_avals(),
+            jax.ShapeDtypeStruct((1, self.MB), i32, sharding=rep),
+            jax.ShapeDtypeStruct((1, W), jnp.int32, sharding=rep),
+            jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
+        ).compile()
+
+    # ------------------------------------------------------------------
+    # chunk-granular registrations (reuse="chunk"; scheduler thread only)
+    # ------------------------------------------------------------------
+    def _chunk_splice_plan(self, prefix):
+        """Can this prefix assemble from per-chunk canonical registrations?
+        Returns ``[(span, registration), ...]`` covering the WHOLE prefix —
+        every span block-aligned, every registration stamp-matched to the
+        cache entry the span was resolved from — or None (the admission
+        falls back to the buffer-scatter path). All-or-nothing: a partial
+        assembly would still scatter the rest, paying both paths."""
+        chunks = getattr(prefix, "chunks", None)
+        if not chunks or not self._chunk_regs:
+            return None
+        bs = self.block_size
+        if sum(c.length for c in chunks) != int(prefix.length):
+            return None
+        plan = []
+        for c in chunks:
+            if c.off % bs or c.length % bs or c.length == 0:
+                return None
+            reg = self._chunk_regs.get(c.key)
+            if (
+                reg is None or reg[3] != c.stamp or reg[2] != c.length
+                or reg[1] % bs or len(reg[0]) != c.length // bs
+            ):
+                return None
+            plan.append((c, reg))
+        try:
+            # fault site "chunk_splice": a mid-splice fault pool-side.
+            # Nothing is allocated yet — decline the plan and the admission
+            # recomputes via the buffer-scatter path, leaking zero blocks.
+            faults.maybe_fail("chunk_splice")
+        except faults.InjectedFault:
+            return None
+        for c, _ in plan:
+            # planned = in use: the cap evicts least-recently-PLANNED
+            self._chunk_regs.move_to_end(c.key)
+        return plan
+
+    def _chunk_splice_into_row(self, row: int, ids_all: List[int], plan):
+        """Assemble a row's prefix from per-chunk canonical registrations:
+        gather each span's source blocks, re-rotate K by the span's
+        position delta into the row's destination blocks, then run the
+        bounded boundary-correction prefills in ascending offset order
+        (each sees the corrected chunks to its left; ``kv_len`` caps its
+        view below everything to the right). Device work only — the caller
+        owns alloc/assign and the EngineStateLost contract."""
+        bs = self.block_size
+        for c, reg in plan:
+            src_ids, canon_off = reg[0], reg[1]
+            nb = len(src_ids)
+            dst = ids_all[c.off // bs : c.off // bs + nb]
+            delta = c.off - canon_off
+            self._cache = self._get("chunk_splice", nb)(
+                self._cache,
+                self._put(jnp.asarray(np.asarray(src_ids, np.int32))),
+                self._put(jnp.asarray(np.asarray(dst, np.int32))),
+                self._put(jnp.int32(delta)),
+            )
+            if delta:
+                flight.emit("rerotate", tokens=c.length, delta=delta)
+            flight.emit("chunk_splice", tokens=c.length, delta=delta, pool=1)
+        row_table = None
+        for c, reg in plan:
+            delta = c.off - reg[1]
+            if (c.exact and delta == 0) or not c.fixup_ids:
+                continue  # canonical placement: content already faithful
+            W = len(c.fixup_ids)
+            if row_table is None:
+                row_table = self._put(
+                    jnp.asarray(self._tables_host[row : row + 1])
+                )
+            toks = np.asarray([list(c.fixup_ids)], np.int32)
+            self._cache = self._get("boundary_px", W)(
+                self.params, self._cache, row_table,
+                self._put(jnp.asarray(toks)), self._put(jnp.int32(c.off)),
+            )
+            flight.emit("boundary_fixup", tokens=W)
+
+    def _register_chunks_from_scatter(self, prefix, ids_all: List[int],
+                                      chain_registered: bool = False):
+        """After a buffer-scatter admission, register each block-aligned
+        EXACT span's freshly scattered blocks as the chunk's canonical pool
+        copy (one pool ref each — they outlive the row). Only exact spans
+        qualify: registering a re-rotated copy would compound drift when a
+        later splice rotates it again. Stamp identity ties the
+        registration to the prefix-cache entry, so a rebuilt entry's stale
+        registration simply stops matching. Only call this from the
+        admission that actually SCATTERED the blocks — on a chain hit the
+        block content was written by an earlier admission and this
+        resolve's spans do not describe it. ``chain_registered``: this
+        admission's full blocks are ALSO chain-registered — the chunk
+        registrations then carry ``counted=False``, which gates ALL THREE
+        accountings (fragmentation tokens, the reclaimable-blocks hint,
+        and the pool's warm-tier ledger): a chain-covered chunk reg's
+        drop frees no blocks while the chain ref lives, so advertising it
+        reclaimable would make the gate queue a request no sweep can
+        place (gauge-grade: once the chain registration drops, its chunk
+        regs under-report until they too are swept)."""
+        chunks = getattr(prefix, "chunks", None)
+        if not chunks:
+            return
+        bs = self.block_size
+        pc = getattr(self.engine_config, "prefix_cache", None)
+        cap = max(1, int(getattr(pc, "chunk_pool_regs", 32) or 32))
+        full_tokens = (int(prefix.length) // bs) * bs
+        for c in chunks:
+            if (
+                not c.exact or c.length == 0
+                or c.off % bs or c.length % bs
+                or c.off + c.length > full_tokens
+            ):
+                continue
+            old = self._chunk_regs.get(c.key)
+            if old is not None and old[3] == c.stamp:
+                continue  # this entry generation is already registered
+            nb = c.length // bs
+            span_ids = ids_all[c.off // bs : c.off // bs + nb]
+            self.kv_pool.ref(span_ids)  # the registration's own ref
+            if old is not None:
+                self._drop_chunk_reg(c.key)
+            counted = not chain_registered
+            self._chunk_regs[c.key] = (
+                list(span_ids), c.off, c.length, c.stamp, counted
+            )
+            if counted:
+                self._chunk_reg_tokens += c.length
+                self._reclaimable_blocks += len(span_ids)
+                self.kv_pool.account_tier("warm", len(span_ids))
+            while len(self._chunk_regs) > cap:  # bounded registration set
+                self._drop_chunk_reg(next(iter(self._chunk_regs)))
+
+    def _drop_chunk_reg(self, key) -> bool:
+        """The one place a chunk registration dies: pops the entry, fixes
+        the fragmentation counter, returns the blocks to the pool."""
+        reg = self._chunk_regs.pop(key, None)
+        if reg is None:
+            return False
+        if reg[4]:
+            n = len(reg[0])
+            self._chunk_reg_tokens -= reg[2]
+            self._reclaimable_blocks = max(0, self._reclaimable_blocks - n)
+            self.kv_pool.account_tier("warm", -n)
+        self.kv_pool.free(reg[0])
+        return True
+
     # ------------------------------------------------------------------
     # paged host bookkeeping (scheduler thread only, like the operations)
     # ------------------------------------------------------------------
@@ -1544,7 +1830,7 @@ class ContinuousEngine:
         want = min(need + 1, self.MB)
         if self.kv_pool.can_alloc(want):
             return "ok"
-        if self._prefix_blocks:
+        if self._prefix_blocks or self._chunk_regs:
             # tier occupancy, not raw headroom: WARM registrations give
             # their blocks to a live admission even while rows decode —
             # the chunk KV survives (int8) in the prefix cache, one
@@ -1552,6 +1838,15 @@ class ContinuousEngine:
             # never a re-prefill. HOT registrations are proven-shared
             # working set and are only sacrificed when nothing decodes
             # (the idle branch below).
+            if self._chunk_regs:
+                # chunk-canonical copies go FIRST (same order as the
+                # growth-pressure path): pure prefill avoidance, rebuilt
+                # from the prefix cache on the next exact scatter —
+                # cheaper to restore than a whole warm chain's re-stage
+                for key in list(self._chunk_regs):
+                    self._drop_chunk_reg(key)
+                    if self.kv_pool.can_alloc(want):
+                        return "ok"
             for key in [
                 k for k, t in list(self._prefix_tier.items()) if t != "hot"
             ]:
@@ -1618,6 +1913,11 @@ class ContinuousEngine:
             # loop), then preempt the newest active row and retry.
             # Non-hot registrations go first — a warm chunk costs one
             # re-scatter to bring back, a hot one a proven-shared re-stage
+            if self._chunk_regs:
+                # chunk-canonical copies go before chain registrations:
+                # they are rebuilt from the cache by any exact scatter
+                self._drop_chunk_reg(next(iter(self._chunk_regs)))
+                continue
             if self._prefix_blocks:
                 victim = min(
                     self._prefix_blocks,
@@ -1674,11 +1974,11 @@ class ContinuousEngine:
         rows = sum(
             max(s.kv_ub - s.shared_tokens, 0) for s in self.slots if s.active
         )
-        # the registration total is a single int maintained on the
+        # the registration totals are single ints maintained on the
         # scheduler thread — iterating _prefix_blocks here would race the
         # scheduler's register/evict and crash a /metrics scrape with
         # "dictionary changed size during iteration"
-        return rows + self._registered_tokens
+        return rows + self._registered_tokens + self._chunk_reg_tokens
 
     # ------------------------------------------------------------------
     # operations (called by the scheduler thread only)

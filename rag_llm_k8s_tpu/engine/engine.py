@@ -873,6 +873,27 @@ class InferenceEngine:
         """Splice a segment block into a prefix buffer at slot ``offset``."""
         return _splice_prefix_planes(buf, block, jnp.int32(offset))
 
+    def rerotate_segment_kv(self, planes, delta: int):
+        """Position-shift a cached segment block by ``delta`` tokens: the
+        chunk-granular reuse primitive (closed-form RoPE re-rotation of the
+        K planes; V passes through). Handles both the native bf16 pair and
+        the int8 4-tuple layout (dequant → rotate → requant)."""
+        from rag_llm_k8s_tpu.models.llama import rerotate_prefix_planes
+
+        return rerotate_prefix_planes(self.config, planes, delta)
+
+    @staticmethod
+    def slice_prefix_block(block, width: int):
+        """The first ``width`` slots of a segment block (payloads
+        ``[L, 1, K, Sb, hd]``, scales ``[L, 1, K, Sb]`` — the slot axis is
+        3 in both): the boundary-correction pass builds a bucket-padded
+        block but must overwrite ONLY its corrected window, or the splice
+        would clobber the chunk's re-rotated tail with builder padding."""
+        return tuple(
+            p[:, :, :, :width] if p.ndim == 4 else p[:, :, :, :width, :]
+            for p in block
+        )
+
     def build_segment_kv(self, ids: Sequence[int], ctx_planes, ctx_len: int):
         """Prefill ONE prompt segment with ``ctx_planes[:ctx_len]`` as its
         left context and return its KV block padded to the segment bucket —

@@ -77,12 +77,38 @@ class CachedPrefix:
     reused_tokens: int  # tokens whose KV came from cache hits
     computed_tokens: int  # tokens prefilled (cache misses) to build this
     # stable identity of the prefix CONTENT (the segment-key chain + total
-    # length), set only under exact-chain reuse: the paged continuous
+    # length), set under exact-chain AND chunk reuse: the paged continuous
     # engine keys its block-granular sharing on it — two requests with the
     # same chain_key map the same physical pool blocks copy-free
     # (ref-counted; ContinuousEngine._admit_prefixed_paged). None under
     # "slot" reuse, whose approximate blocks are NOT content-identical.
+    # (Under "chunk" the shared blocks are whatever one resolve assembled
+    # for the chain — within the policy's pinned tolerance by contract.)
     chain_key: Optional[Tuple] = None
+    # chunk-granular layout (reuse="chunk" only): one ChunkSpan per segment
+    # in prompt order — the paged engine's per-chunk block-table assembly
+    # reads these to splice registered pool blocks at arbitrary order
+    # (ContinuousEngine._chunk_splice_plan). None under exact/slot reuse.
+    chunks: Optional[Tuple] = None
+
+
+@dataclass(frozen=True)
+class ChunkSpan:
+    """One segment's placement inside a resolved chunk-reuse prefix: where
+    it sits (``off``/``length``), which cache entry supplied it (``stamp``
+    — the creation-stamp identity every install/release path checks),
+    whether its content is bit-faithful to the canonical computation
+    (``exact``: a canonical-position, canonical-chain hit or a fresh build
+    — only these are eligible for pool-side canonical registration), and
+    the boundary-correction window's token ids (``fixup_ids`` — what a
+    pool-side splice re-prefills at this span's offset)."""
+
+    key: str
+    off: int
+    length: int
+    stamp: int
+    exact: bool
+    fixup_ids: Tuple[int, ...]
 
 
 @dataclass
@@ -112,6 +138,13 @@ class _Entry:
     # engine): splices must dequantize first, and the bounded int8 drift
     # applies to everything served from this entry until it is rebuilt
     quantized: bool = False
+    # chunk-granular reuse (reuse="chunk"): the CANONICAL position this
+    # entry's KV was computed at — a hit at (canon_off, canon_chain) serves
+    # bit-identically; any other placement re-rotates K by the position
+    # delta and boundary-corrects. Unused under exact/slot reuse (their
+    # keys already pin the offset).
+    canon_off: int = 0
+    canon_chain: Tuple = ()
 
 
 def _planes_nbytes(planes: Tuple) -> int:
@@ -127,9 +160,10 @@ class PrefixCache:
     """
 
     def __init__(self, config, engine, tiering=None):
-        if config.reuse not in ("exact", "slot"):
+        if config.reuse not in ("exact", "slot", "chunk"):
             raise ValueError(
-                f"prefix_cache.reuse={config.reuse!r}: expected 'exact' or 'slot'"
+                f"prefix_cache.reuse={config.reuse!r}: expected 'exact', "
+                "'slot' or 'chunk'"
             )
         self.config = config
         self.engine = engine  # owning InferenceEngine (builds the blocks)
@@ -149,6 +183,28 @@ class PrefixCache:
         else:
             self.hotness = None
             self.spill = None
+        # chunk-granular reuse hotness gate: shifted splices are allowed
+        # only for chunks whose decayed hit frequency clears
+        # config.chunk_hot_min — the tiering tracker when tiering is on
+        # (one signal for both decisions), else a cache-private tracker
+        # with the same decay grammar. None outside "chunk" mode.
+        if config.reuse == "chunk" and self.hotness is None:
+            self._chunk_hotness = HotnessTracker(300.0)
+        else:
+            self._chunk_hotness = self.hotness
+        # chunk-reuse outcome counters (rag_prefix_chunk_reuse_total):
+        # chain_exact = served bit-identically from the canonical position,
+        # spliced = reused at the canonical offset under a different chain,
+        # rerotated = position-shifted via RoPE re-rotation, recompute =
+        # built fresh (miss, cold chunk, or splice-fault fallback)
+        self._chunk_counts: Dict[str, int] = {
+            "chain_exact": 0, "spliced": 0, "rerotated": 0, "recompute": 0,
+            "splice_faults": 0, "boundary_tokens": 0,
+        }
+        # chunk spans recorded with each assembled-memo buffer (keys ⊆
+        # _assembled) so a memo hit still carries the per-chunk layout the
+        # paged engine's block-table assembly consumes
+        self._assembled_spans: Dict[tuple, Tuple] = {}
         # anchored at construction: the first opportunistic sweep waits a
         # full interval (a cache with nothing demotable yet should not pay
         # a sweep on its very first resolve)
@@ -190,7 +246,18 @@ class PrefixCache:
     def _entry_key(self, seg_key: str, offset: int, chain: Tuple[str, ...]):
         if self.config.reuse == "slot":
             return (seg_key, offset)
+        if self.config.reuse == "chunk":
+            # ONE canonical entry per segment: the entry itself records the
+            # position/chain it was computed at (canon_off/canon_chain) and
+            # any other placement re-rotates + boundary-corrects
+            return (seg_key,)
         return (seg_key, offset, chain)
+
+    def chunk_reuse_counters(self) -> Dict[str, int]:
+        """Chunk-granular reuse outcome counters (the source of
+        ``rag_prefix_chunk_reuse_total``; all zero outside reuse="chunk")."""
+        with self._lock:
+            return dict(self._chunk_counts)
 
     def pin(self, seg_key: str) -> None:
         """Mark a segment key (e.g. the fixed prompt head) never-evicted."""
@@ -292,21 +359,46 @@ class PrefixCache:
                     if e is not None:
                         self._entries.move_to_end(ek)
                         e.uses += 1
-                    if self.hotness is not None:
-                        # a memo hit is the hottest possible signal — the
-                        # whole chain served without touching a block
-                        self.hotness.touch(key)
+                    # a memo hit is the hottest possible signal — the
+                    # whole chain served without touching a block. The
+                    # chunk-private tracker (tiering off) must see it too,
+                    # or memo-dominated hot traffic would never clear the
+                    # chunk_hot_min gate for its own permutations.
+                    tracker = (
+                        self.hotness if self.hotness is not None
+                        else self._chunk_hotness
+                    )
+                    if tracker is not None:
+                        tracker.touch(key)
                     off += len(ids)
                     chain = chain + (key,)
                 self.hits += len(segments)
                 self.tokens_reused += total
+                if self.config.reuse == "chunk":
+                    # a memo hit re-serves the assembly AS IT WAS BUILT:
+                    # spans that were bit-faithful count chain_exact,
+                    # drifted (rerotated/corrected) spans count spliced —
+                    # the chain_exact/spliced ratio stays an honest bound
+                    # on drift exposure even for memo-dominated traffic
+                    memo_spans = self._assembled_spans.get(akey)
+                    if memo_spans is not None:
+                        for sp in memo_spans:
+                            self._chunk_counts[
+                                "chain_exact" if sp.exact else "spliced"
+                            ] += 1
+                    else:
+                        self._chunk_counts["chain_exact"] += len(segments)
                 if _staged is not None:
                     _staged["chain_key"] = akey
                     _staged["created"] = []
                     _staged["memo_new"] = False
                 hit = CachedPrefix(
                     memo[0], memo[1], P, total, 0,
-                    chain_key=akey if self.config.reuse == "exact" else None,
+                    chain_key=(
+                        akey if self.config.reuse in ("exact", "chunk")
+                        else None
+                    ),
+                    chunks=self._assembled_spans.get(akey),
                 )
             else:
                 hit = None
@@ -321,17 +413,25 @@ class PrefixCache:
             self.retier()
             return hit
 
+        chunk_mode = self.config.reuse == "chunk"
+        Wcfg = int(getattr(self.config, "boundary_tokens", 0))
         buf = self.engine.prefix_buffer_zero()
         off = 0
         chain: Tuple[str, ...] = ()
         reused = computed = n_hit = n_miss = 0
         created: List[tuple] = []  # (key, uses0, stamp) this resolve built
+        spans: List[ChunkSpan] = []
+        outcomes: Dict[str, int] = {}
+        fixup_tokens = 0
         for key, ids in segments:
             seg_len = len(ids)
             ek = self._entry_key(key, off, chain)
             planes: Optional[Tuple] = None
             quantized = False
             swap = None  # (stamp, score) when a cold entry needs a swap-in
+            outcome = None  # chunk-mode reuse outcome for this segment
+            shifted = False  # takes the rotate/boundary-correct machinery
+            delta = 0
             with self._lock:
                 e = self._entries.get(ek)
                 if e is not None and e.seg_len == seg_len:
@@ -339,21 +439,44 @@ class PrefixCache:
                     e.uses += 1
                 else:
                     e = None  # slot/length mismatch: treat as a miss
+                score = None
                 if self.tiering is not None:
                     score = self.hotness.touch(key)
-                    if e is not None:
-                        if e.tier == "cold":
-                            swap = (e.stamp, score)
-                        elif (
-                            e.tier == "warm"
-                            and score >= self.tiering.warm_below
-                        ):
-                            # promotion roughly doubles this entry's device
-                            # bytes — re-enforce the budget or a
-                            # hit-dominated steady state (no inserts) could
-                            # sit over it indefinitely
-                            self._promote_locked(e)
-                            self._enforce_budget_locked(keep=ek)
+                elif chunk_mode:
+                    score = self._chunk_hotness.touch(key)
+                if chunk_mode and e is not None:
+                    if e.canon_off == off and e.canon_chain == chain:
+                        # canonical placement: bit-identical UNLESS the
+                        # entry went through the warm int8 round trip —
+                        # label that drift honestly (the serve path is
+                        # unchanged: dequantized splice under the warm
+                        # tier's tolerance contract, no rotation/fixup)
+                        outcome = (
+                            "chain_exact" if not e.quantized else "spliced"
+                        )
+                    elif score >= self.config.chunk_hot_min:
+                        delta = off - e.canon_off
+                        outcome = "rerotated" if delta else "spliced"
+                        shifted = True
+                    else:
+                        # cold/one-shot chunk: the drift budget is spent
+                        # only where the savings recur — rebuild at THIS
+                        # position (re-canonicalizing the entry)
+                        e = None
+                        outcome = "recompute"
+                if self.tiering is not None and e is not None:
+                    if e.tier == "cold":
+                        swap = (e.stamp, score)
+                    elif (
+                        e.tier == "warm"
+                        and score >= self.tiering.warm_below
+                    ):
+                        # promotion roughly doubles this entry's device
+                        # bytes — re-enforce the budget or a
+                        # hit-dominated steady state (no inserts) could
+                        # sit over it indefinitely
+                        self._promote_locked(e)
+                        self._enforce_budget_locked(keep=ek)
                 # SNAPSHOT while still locked: tier transitions mutate the
                 # entry in place, so planes/quantized must never be re-read
                 # after release — a concurrent demote could hand the splice
@@ -367,9 +490,45 @@ class PrefixCache:
                 # fall through to recompute-from-tokens below
                 res = self._swap_in(ek, swap[0], _trigger, swap[1])
                 if res is None:
+                    # the segment will be REBUILT from tokens below: it is
+                    # a recompute, not a shifted splice — clearing these
+                    # keeps the reused/computed accounting (and the
+                    # chunk_splice/boundary_fixup events) honest
                     e = None
+                    shifted = False
+                    delta = 0
+                    if outcome is not None:
+                        outcome = "recompute"
                 else:
                     planes, quantized = res
+            e_stamp = e.stamp if e is not None else 0
+            was_miss = False
+            if e is not None and shifted:
+                # the shifted-splice path can fault (fault site
+                # chunk_splice) or fail in the rotation op: both fall back
+                # to recompute-from-tokens — nothing was allocated yet, so
+                # the fallback leaks zero entries/blocks by construction
+                try:
+                    faults.maybe_fail("chunk_splice")
+                    if quantized and len(planes) == 4:
+                        planes = dequantize_planes(planes, buf[0].dtype)
+                        quantized = False
+                    if delta:
+                        planes = self.engine.rerotate_segment_kv(
+                            planes, delta
+                        )
+                        flight.emit("rerotate", tokens=seg_len, delta=delta)
+                except Exception:  # noqa: BLE001 — KeyboardInterrupt propagates
+                    logger.warning(
+                        "chunk splice failed for %r; recomputing", ek,
+                        exc_info=True,
+                    )
+                    with self._lock:
+                        self._chunk_counts["splice_faults"] += 1
+                    e = None
+                    outcome = "recompute"
+                    shifted = False
+                    planes, quantized = None, False
             if e is None:
                 # build with the true left context (buf holds chain's KV):
                 # under "exact" reuse this makes the block bit-faithful to
@@ -379,6 +538,7 @@ class PrefixCache:
                     planes=planes, seg_len=seg_len,
                     nbytes=_planes_nbytes(planes),
                     pinned=key in self._pinned_keys,
+                    canon_off=off, canon_chain=chain,
                 )
                 self._insert(ek, e)
                 # staging identity is snapshotted HERE, at creation: uses
@@ -388,11 +548,14 @@ class PrefixCache:
                 # between splices and that lock) erase the consumption
                 # evidence release_staged's uses-moved check depends on
                 created.append((ek, 0, e.stamp))
+                e_stamp = e.stamp
+                was_miss = True
                 n_miss += 1
                 computed += seg_len
+                if chunk_mode:
+                    outcome = "recompute"
             else:
                 n_hit += 1
-                reused += seg_len
             if quantized and len(planes) == 4:
                 # warm entry on a non-int8 engine: rebuild native-dtype
                 # planes for the splice from the LOCKED snapshot (the
@@ -400,6 +563,38 @@ class PrefixCache:
                 # warm tier's bounded drift.
                 planes = dequantize_planes(planes, buf[0].dtype)
             buf = self.engine.splice_prefix(buf, planes, off)
+            if shifted:
+                # bounded boundary correction: re-prefill the chunk's first
+                # W tokens with the TRUE left context — the slots where
+                # cross-chunk attention actually differs from the canonical
+                # computation. The corrected block overwrites exactly its
+                # window (the re-rotated tail stays).
+                W = min(Wcfg, seg_len)
+                if W > 0:
+                    fix = self.engine.build_segment_kv(ids[:W], buf, off)
+                    buf = self.engine.splice_prefix(
+                        buf, self.engine.slice_prefix_block(fix, W), off
+                    )
+                    flight.emit("boundary_fixup", tokens=W)
+                    fixup_tokens += W
+                    computed += W
+                    reused += seg_len - W
+                else:
+                    reused += seg_len
+                flight.emit(
+                    "chunk_splice", tokens=seg_len, delta=delta,
+                )
+            elif not was_miss:
+                # exact/slot hit, or a chunk-mode canonical-position hit
+                reused += seg_len
+            if outcome is not None:
+                outcomes[outcome] = outcomes.get(outcome, 0) + 1
+            if chunk_mode:
+                spans.append(ChunkSpan(
+                    key=key, off=off, length=seg_len, stamp=e_stamp,
+                    exact=outcome in ("chain_exact", "recompute"),
+                    fixup_ids=tuple(int(t) for t in ids[:Wcfg]),
+                ))
             off += seg_len
             chain = chain + (key,)
 
@@ -409,6 +604,9 @@ class PrefixCache:
             self.misses += n_miss
             self.tokens_reused += reused
             self.tokens_computed += computed
+            for k, v in outcomes.items():
+                self._chunk_counts[k] += v
+            self._chunk_counts["boundary_tokens"] += fixup_tokens
             # two threads can resolve the same chain concurrently (both miss
             # the memo check): drop the loser's bytes before re-assigning or
             # assembled_bytes would over-count forever
@@ -416,6 +614,8 @@ class PrefixCache:
             if prev is not None:
                 self.assembled_bytes -= _planes_nbytes(prev[0])
             self._assembled[akey] = (buf, off)
+            if chunk_mode:
+                self._assembled_spans[akey] = tuple(spans)
             self._assembled_uses[akey] = 0
             self._creation_seq += 1
             self._assembled_stamp[akey] = self._creation_seq
@@ -460,7 +660,10 @@ class PrefixCache:
         self.retier()
         return CachedPrefix(
             buf, off, P, reused, computed,
-            chain_key=akey if self.config.reuse == "exact" else None,
+            chain_key=(
+                akey if self.config.reuse in ("exact", "chunk") else None
+            ),
+            chunks=tuple(spans) if chunk_mode else None,
         )
 
     # -- lookahead staging (rag/lookahead.py drives these) ---------------
@@ -818,6 +1021,7 @@ class PrefixCache:
             return False
         self._assembled_uses.pop(key, None)
         self._assembled_stamp.pop(key, None)
+        self._assembled_spans.pop(key, None)
         self.assembled_bytes -= _planes_nbytes(item[0])
         return True
 
@@ -868,6 +1072,7 @@ class PrefixCache:
             self._assembled.clear()
             self._assembled_uses.clear()
             self._assembled_stamp.clear()
+            self._assembled_spans.clear()
             self.entry_bytes = 0
             self.assembled_bytes = 0
             if self.spill is not None:

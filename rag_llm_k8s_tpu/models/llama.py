@@ -216,6 +216,32 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
+def rerotate_prefix_planes(config: LlamaConfig, planes: Tuple, delta: int) -> Tuple:
+    """Position-shift a cached segment-KV plane tuple by ``delta`` tokens:
+    the K plane(s) re-rotate by the closed-form RoPE delta
+    (:func:`ops.attention.rope_rerotate`) while V — position-free — passes
+    through untouched. This is the attention-invariance primitive behind
+    chunk-granular prefix reuse (``PrefixCacheConfig.reuse="chunk"``): a
+    chunk's KV computed once at a canonical offset splices into any prompt
+    position without re-prefill.
+
+    ``planes`` is either ``(k, v)`` with payloads ``[L, 1, K, S, hd]`` or
+    the int8 4-tuple ``(k, v, k_scale, v_scale)`` (scales ``[L, 1, K, S]``)
+    — the quantized path goes dequant → rotate → requant with per-vector
+    scale recomputation. ``delta == 0`` returns ``planes`` unchanged (the
+    canonical-position hit stays bit-identical)."""
+    from rag_llm_k8s_tpu.ops.attention import rope_rerotate, rope_rerotate_q8
+
+    if int(delta) == 0:
+        return planes
+    inv = rope_frequencies(config)
+    d = jnp.int32(delta)
+    if len(planes) == 4:
+        k_q, k_scale = rope_rerotate_q8(planes[0], planes[2], d, inv)
+        return (k_q, planes[1], k_scale, planes[3])
+    return (rope_rerotate(planes[0], d, inv), planes[1])
+
+
 # ---------------------------------------------------------------------------
 # modules
 # ---------------------------------------------------------------------------

@@ -106,6 +106,14 @@ EVENTS: Dict[str, str] = {
                "tail)",
     "swap_in_fallback": "a failed swap-in fell back to "
                         "recompute-from-tokens (host buffer released)",
+    "chunk_splice": "a hot chunk's canonical KV spliced at an arbitrary "
+                    "prompt position (chunk-granular reuse; tokens, delta; "
+                    "pool=1 when assembled straight into pool blocks)",
+    "rerotate": "cached K planes position-shifted by the closed-form RoPE "
+                "delta rotation (tokens, delta) — no re-prefill",
+    "boundary_fixup": "a spliced chunk's first tokens re-prefilled with "
+                      "the true left context (tokens) — the bounded "
+                      "boundary-correction pass",
     "host_spill_evict": "the host spill store's byte budget evicted a "
                         "cold chunk's backing (bytes)",
     # -- retrieval lookahead (rag/lookahead.py) --------------------------

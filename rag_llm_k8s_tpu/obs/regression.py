@@ -65,6 +65,9 @@ _RULES: Tuple[Tuple[re.Pattern, str], ...] = tuple(
         # environment property (the harness's host link), not repo perf —
         # and the per-round target constant
         (r"tunnel_fetch|target", "ignore"),
+        # chunk-reuse leg's exact-policy CONTROL numbers (reported for
+        # contrast, deliberately unjudged) — must precede the qps rule
+        (r"exact_skip_frac|exact_resolve_qps", "ignore"),
         # -- higher is better ---------------------------------------------
         (r"tok_per_s|tokens_per_sec|per_s$|_per_s(\.|_|$)|qps", "higher"),
         (r"mfu|vs_baseline|tokens_per_verify|reduction", "higher"),
@@ -74,6 +77,12 @@ _RULES: Tuple[Tuple[re.Pattern, str], ...] = tuple(
         # KV-tiering leg (ISSUE 8): servable-capacity multiplier at fixed
         # HBM and the fraction of swap-ins hidden under decode
         (r"effective_capacity_x|hide_rate", "higher"),
+        # chunk-reuse leg (ISSUE 12): prefill tokens skipped on the
+        # shuffled-composition stream — shrinkage is a regression; the
+        # measured logit error must not grow past its pin either
+        (r"prefill_skip_frac", "higher"),
+        (r"logit_max_err", "lower"),
+        (r"logit_tol", "ignore"),
         # -- lower is better ----------------------------------------------
         # flight-recorder cost (ISSUE 11): fraction of decode steps/s the
         # journal costs with the recorder on — growth is a regression

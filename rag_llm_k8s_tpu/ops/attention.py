@@ -601,6 +601,62 @@ def attention_xla(
 
 
 # ---------------------------------------------------------------------------
+# RoPE re-rotation of cached K planes (chunk-granular prefix reuse)
+# ---------------------------------------------------------------------------
+#
+# RoPE is a per-position orthogonal rotation of each (i, i + hd/2) pair of
+# the K vector: K computed at position p and reused at position p + delta
+# differs ONLY by a further rotation of angle delta * inv_freq per pair — a
+# closed form over bytes already in HBM, no re-prefill (SIFT's attention
+# invariance: retrieved-chunk KV is largely position/composition-invariant,
+# so a hot chunk's KV is computed ONCE at a canonical position and spliced
+# anywhere by rotating the cached K planes by the position delta). V carries
+# no positional encoding and splices untouched. delta == 0 is exactly the
+# identity (cos 0 = 1, sin 0 = 0 — the multiply-by-one round trip is exact
+# in every dtype), so a canonical-position hit stays bit-identical.
+
+
+@jax.jit
+def rope_rerotate(k: jax.Array, delta: jax.Array, inv_freqs: jax.Array) -> jax.Array:
+    """Rotate cached K planes ``[..., hd]`` by a uniform position ``delta``
+    (scalar int): the pairwise-by-halves rotation of ``apply_rope`` with
+    phase ``delta * inv_freq`` — position-shifting every token of a cached
+    segment in one VPU pass. Computes in fp32, returns ``k``'s dtype."""
+    half = k.shape[-1] // 2
+    phase = delta.astype(jnp.float32) * inv_freqs  # [hd/2]
+    c, s = jnp.cos(phase), jnp.sin(phase)
+    x1 = k[..., :half].astype(jnp.float32)
+    x2 = k[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+    ).astype(k.dtype)
+
+
+@jax.jit
+def rope_rerotate_q8(
+    k_q: jax.Array,  # [..., hd] int8 payload
+    k_scale: jax.Array,  # [...] fp32 per-(token, head) vector scale
+    delta: jax.Array,
+    inv_freqs: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """``rope_rerotate`` over the int8-quantized K layout (the warm tier /
+    int8-KV engines): dequant → rotate → requant. The rotation pairs dims
+    ``i`` and ``i + hd/2`` of the SAME token vector, which shares one
+    symmetric scale — but it changes the vector's max-abs, so the scale is
+    recomputed per vector (same grammar as :func:`quantize_kv`) instead of
+    carried; drift stays bounded at max|x|/254 per element either way."""
+    xf = k_q.astype(jnp.float32) * k_scale[..., None]
+    half = xf.shape[-1] // 2
+    phase = delta.astype(jnp.float32) * inv_freqs
+    c, s = jnp.cos(phase), jnp.sin(phase)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    rot = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    scale = jnp.maximum(jnp.max(jnp.abs(rot), axis=-1), 1e-8) / 127.0
+    q = jnp.round(rot / scale[..., None]).astype(jnp.int8)
+    return q, scale
+
+
+# ---------------------------------------------------------------------------
 # weight-only-int8 KV cache (kv_quant="int8")
 # ---------------------------------------------------------------------------
 #

@@ -60,9 +60,14 @@ __all__ = [
 #                  and the paged prestage scatter): a failed swap must fall
 #                  back to recompute-from-tokens, release the host buffer,
 #                  and leak zero blocks on either substrate.
+#   chunk_splice — a chunk-granular prefix-reuse splice (engine/
+#                  prefix_cache.py rerotate path and the paged per-chunk
+#                  block assembly in engine/continuous.py): a failed splice
+#                  must fall back to recompute-from-tokens (cache) or the
+#                  buffer-scatter path (pool) and leak zero blocks/entries.
 SITES = (
     "store_lookup", "embed", "insert", "decode_step", "generate",
-    "lookahead_retrieve", "kv_swap_in",
+    "lookahead_retrieve", "kv_swap_in", "chunk_splice",
 )
 
 ENV_VAR = "TPU_RAG_FAULTS"

@@ -164,6 +164,7 @@ class RagService:
         # per-scrape memo for the rag_kv_tier_* callback fan-out (see
         # _pcache_tier_stats); must exist before any scrape can fire
         self._tier_stats_memo = None
+        self._chunk_counters_memo = None
         # engine flight recorder + incident bundles (obs/flight.py): the
         # journal is process-wide (decision points across the substrate
         # write to it long before any service exists), so the service only
@@ -481,6 +482,23 @@ class RagService:
             "TPU_RAG_KV_TIERING_HOST_MB; oldest spills evict past it)",
             fn=lambda: self._pcache_tier_stats().get("tier_cold_host_bytes", 0.0),
         )
+        # chunk-granular prefix reuse (reuse="chunk", docs/PREFIX_CACHE.md
+        # "chunk-granular reuse"): per-segment resolve outcomes — family
+        # exists in every mode (zeros outside chunk reuse)
+        chunk_reuse = reg.labeled_counter(
+            "rag_prefix_chunk_reuse_total",
+            "chunk-granular prefix-reuse outcomes per resolved segment "
+            "(chain_exact — bit-identical canonical content, incl. memo "
+            "re-serves of exact spans; spliced — drifted reuse at the "
+            "same offset or a memo re-serve of corrected content; "
+            "rerotated — position-shifted via RoPE re-rotation; "
+            "recompute — miss / cold chunk / splice-fault fallback)",
+        )
+        for oc in ("chain_exact", "spliced", "rerotated", "recompute"):
+            chunk_reuse.labels_callback(
+                lambda oc=oc: self._pcache_chunk_counters().get(oc, 0.0),
+                outcome=oc,
+            )
         tier_pool = reg.labeled_gauge(
             "rag_kv_tier_pool_blocks",
             "paged-pool blocks by holder tier: hot/warm are registered "
@@ -619,6 +637,26 @@ class RagService:
             if pcache is not None:
                 total += pcache.counters().get(name, 0)
         return total
+
+    def _pcache_chunk_counters(self) -> Dict[str, float]:
+        """Summed ``PrefixCache.chunk_reuse_counters()`` over the serving
+        engines (the rag_prefix_chunk_reuse_total family's source; zeros
+        outside reuse="chunk"). Memoized for a beat like the tier-stats
+        snapshot: the 4 outcome callbacks read this per scrape, and each
+        fresh compute takes every cache's resolve-path lock — one snapshot
+        serves the whole scrape (benign race on the memo)."""
+        now = time.monotonic()
+        cached = self._chunk_counters_memo
+        if cached is not None and now - cached[0] < 0.25:
+            return cached[1]
+        out: Dict[str, float] = {}
+        for e in self._engines().values():
+            pcache = getattr(e, "prefix_cache", None)
+            if pcache is not None and hasattr(pcache, "chunk_reuse_counters"):
+                for k, v in pcache.chunk_reuse_counters().items():
+                    out[k] = out.get(k, 0.0) + v
+        self._chunk_counters_memo = (now, out)
+        return out
 
     def _pool_tier_occupancy(self) -> Dict[str, int]:
         """The scheduler engine's registered-block tier ledger (scrape
@@ -1536,6 +1574,14 @@ class RagService:
         total_prompt = cp.length + len(b_ids)
         timings["prefix_reuse_frac"] = cp.reused_tokens / max(total_prompt, 1)
         timings["prefill_tokens_skipped"] = float(cp.reused_tokens)
+        # of the tokens the prefix cache RESOLVED, the fraction whose
+        # prefill was actually skipped — under chunk reuse the boundary-
+        # correction windows count as computed, so this is the honest
+        # per-request savings number (prefix_reuse_frac counts the whole
+        # resolved prefix against the whole prompt)
+        timings["prefill_tokens_skipped_frac"] = cp.reused_tokens / max(
+            cp.reused_tokens + cp.computed_tokens + len(b_ids), 1
+        )
         timings["total_ms"] = (time.monotonic() - t_all) * 1e3
         self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
         self.metrics.inc("query_decode_tokens", len(out_ids))

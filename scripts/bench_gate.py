@@ -62,6 +62,11 @@ REQUIRED_KEYS = (
     # B=8 continuous decode; acceptance ≤ 2%) — the recorder is ON by
     # default, so its overhead may never go unjudged in a bench round
     "flight_overhead.overhead_frac",
+    # ISSUE 12: chunk-granular prefix reuse — prefill tokens skipped on
+    # the shuffled-composition stream (acceptance ≥ 0.5 with the logit
+    # tolerance green); a silently dropped leg must fail the gate instead
+    # of reading as "chunk reuse unjudged"
+    "chunk_reuse.prefill_skip_frac",
 )
 
 

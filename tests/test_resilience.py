@@ -822,3 +822,77 @@ class TestKvSwapInChaos:
         assert cont.prestage_prefix(cp2) == "registered"
         assert cont.release_prestaged(cp2.chain_key) is True
         assert cont.kv_pool.available() == free0
+
+
+class TestChunkSpliceChaos:
+    def test_mid_splice_fault_recomputes_and_leaks_nothing(self, tiny):
+        """Armed ``chunk_splice`` (ISSUE 12 chaos contract): a shifted
+        chunk splice that dies mid-flight falls back to RECOMPUTE — the
+        cache rebuilds the chunk from tokens with no entry lost and exact
+        byte accounting, and the paged per-chunk assembly declines its
+        plan BEFORE allocating, so the admission scatters the buffer
+        instead. Zero leaked entries/blocks on both substrates."""
+        import dataclasses
+
+        cfg, params, _ = tiny
+        pc = PrefixCacheConfig(
+            enabled=True, max_prefix_tokens=64, segment_buckets=(16,),
+            suffix_buckets=(16,), hbm_budget_mb=64, reuse="chunk",
+            boundary_tokens=4, chunk_hot_min=0.0,
+        )
+        ie = InferenceEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=EngineConfig(
+                prompt_buckets=(64, 128), max_batch_size=2, max_seq_len=256,
+                prefix_cache=pc,
+            ),
+            dtypes=FP32,
+        )
+        cache = ie.prefix_cache
+        head = [int(cfg.bos_token_id)] + [7] * 15
+        a, b = [9] * 16, [11] * 16
+        suffix = [5, 6, 7]
+        cache.prefix_for([("head:cs", head), ("A:cs", a), ("B:cs", b)])
+        entries0 = len(cache._entries)
+        faults.arm("chunk_splice", times=2)  # both shifted chunks
+        cp = cache.prefix_for([("head:cs", head), ("B:cs", b), ("A:cs", a)])
+        assert faults.armed() == {}, "chunk_splice never fired"
+        counts = cache.chunk_reuse_counters()
+        assert counts["splice_faults"] == 2 and counts["rerotated"] == 0
+        assert len(cache._entries) == entries0
+        assert cache.entry_bytes == sum(
+            e.nbytes for e in cache._entries.values()
+        )
+
+        # paged substrate: the plan declines before any allocation — the
+        # admission scatters the fresh buffer and every block is accounted
+        cont = ContinuousEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=dataclasses.replace(
+                ie.engine_config, kv_paged=True, kv_block_size=16
+            ),
+            dtypes=FP32,
+        )
+        _, fin = cont.admit_prefixed(1, suffix, cp, max_new=4)
+        while cont.has_active():
+            for _r, toks in cont.step():
+                fin = toks
+        assert cont._chunk_regs  # exact spans registered for next time
+        cache._assembled.clear()
+        cache.assembled_bytes = 0
+        cache._assembled_spans.clear()
+        cp2 = cache.prefix_for(
+            [("head:cs", head), ("B:cs", b), ("A:cs", a)]
+        )
+        faults.arm("chunk_splice", times=1)
+        assert cont._chunk_splice_plan(cp2) is None  # declined, pre-alloc
+        assert faults.armed() == {}, "paged chunk_splice never fired"
+        _, fin2 = cont.admit_prefixed(2, suffix, cp2, max_new=4)
+        while cont.has_active():
+            for _r, toks in cont.step():
+                fin2 = toks
+        for k in list(cont._chunk_regs):
+            cont._drop_chunk_reg(k)
+        for k in list(cont._prefix_blocks):
+            cont._drop_registration(k)
+        assert cont.kv_pool.blocks_in_use() == 0
