@@ -83,6 +83,18 @@ tiering-smoke:
 splice-smoke:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_chunk_reuse.py::TestSmoke -q -p no:cacheprovider
 
+# Paged-speculation smoke (ISSUE 13, docs/SPECULATIVE.md): with
+# TPU_RAG_SPEC_PAGED-style speculation enabled on the tiny config, paged
+# continuous greedy AND seeded-sampled streams are BYTE-IDENTICAL to
+# speculation-off across mixed-length admission groups and mid-flight
+# admission, with verify steps proven to fire (non-vacuous). The full
+# matrix (EOS mid-window, budget clamps, slot-ladder top, prefixed
+# admissions, preemption, adaptive-K, tp=2) lives in the rest of
+# tests/test_spec_paged.py and runs under tier1; the chaos interactions
+# ride `make chaos` (tests/test_resilience.py::TestSpecChaos).
+spec-smoke:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_spec_paged.py::TestSmoke -q -p no:cacheprovider
+
 # Flight-recorder smoke (ISSUE 11, docs/OBSERVABILITY.md "Engine flight
 # recorder"): with the fault harness armed, a forced reset storm must
 # produce an incident bundle whose per-request timelines reconstruct each
@@ -155,7 +167,7 @@ check: test tpu-test bench
 # (validates the baseline + gate plumbing without running the bench — the
 # TPU-judged comparison is `make bench` followed by
 # `make bench-gate BENCH_CURRENT=...`).
-ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke flight-smoke lint analyze
+ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke flight-smoke lint analyze
 	python scripts/bench_gate.py --baseline $(BENCH_BASELINE) --dry-run
 
-.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke flight-smoke ci lint analyze check validate-8b validate-70b
+.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke flight-smoke ci lint analyze check validate-8b validate-70b

@@ -549,6 +549,39 @@ class EngineConfig:
     # required (num_kv_heads % tp == 0) is checked by validate_tp_layout
     # at engine construction. Env: TPU_RAG_KV_POOL_BLOCKS.
     kv_pool_blocks: int = 0
+    # speculative decoding for the PAGED CONTINUOUS engine (the production
+    # serving substrate; docs/SPECULATIVE.md). The scheduler drafts up to
+    # spec_paged_tokens continuation tokens per row by prompt-lookup over
+    # the row's OWN history (assembled prompt + emitted — grounded RAG
+    # answers heavily copy their retrieved context, so the context is the
+    # draft corpus; no draft model), and each sync window runs ONE
+    # multi-token verify step through the block tables: K+1 fed tokens per
+    # row, K+1 logit planes back, per-row longest-prefix acceptance
+    # against the model's own (seed, position)-keyed targets — greedy AND
+    # seeded sampled streams are BYTE-IDENTICAL to spec-off by
+    # construction (tests/test_spec_paged.py pins it across mixed-length
+    # admission groups, mid-flight admission, preemption/reset recovery,
+    # prefix admissions and tp=2). Requires kv_paged=True (checked at
+    # engine construction). Orthogonal to the one-shot engine's
+    # `speculative` knob above, which keeps serving the batch-1 coalesce
+    # path. Env: TPU_RAG_SPEC_PAGED.
+    spec_paged: bool = False
+    # drafted tokens per verify step (the verify forward feeds K+1 tokens
+    # per row). Decode is weight-bandwidth-bound, so width is nearly free
+    # on the device — the cost of a wide MISS is the extra logit planes
+    # and junk KV writes, so the per-row adaptive controller (below)
+    # shrinks K where acceptance is low. 7 (8 fed tokens) is the
+    # continuous default: B rows verify TOGETHER, so the [B, K+1, V]
+    # logit volume scales with batch — half the one-shot path's k=15.
+    # Env: TPU_RAG_SPEC_PAGED_TOKENS.
+    spec_paged_tokens: int = 7
+    # per-row adaptive draft length: each verify window folds the row's
+    # measured acceptance FRACTION (accepted / offered) into a decayed
+    # EMA; below this floor the row degrades to K=1 (one probe token per
+    # window — ~free, and the row recovers within a few windows when its
+    # output starts quoting again), above it K scales with the EMA.
+    # Env: TPU_RAG_SPEC_PAGED_MIN_ACCEPT.
+    spec_paged_min_accept: float = 0.3
     # cross-request KV prefix cache (see PrefixCacheConfig)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
     # hotness-aware KV tiering over the cached chunks (see KVTieringConfig;
@@ -908,6 +941,28 @@ class AppConfig:
                     f"TPU_RAG_KV_POOL_BLOCKS={nb}: expected >= 0 (0 = dense parity)"
                 )
             engine = dataclasses.replace(engine, kv_pool_blocks=nb)
+        if "TPU_RAG_SPEC_PAGED" in env:
+            flag = env["TPU_RAG_SPEC_PAGED"]
+            if flag not in ("0", "1"):
+                raise ValueError(
+                    f"TPU_RAG_SPEC_PAGED={flag!r}: expected '0' or '1'"
+                )
+            engine = dataclasses.replace(engine, spec_paged=flag == "1")
+        if "TPU_RAG_SPEC_PAGED_TOKENS" in env:
+            st = int(env["TPU_RAG_SPEC_PAGED_TOKENS"])
+            if st < 1:
+                raise ValueError(
+                    f"TPU_RAG_SPEC_PAGED_TOKENS={st}: expected >= 1"
+                )
+            engine = dataclasses.replace(engine, spec_paged_tokens=st)
+        if "TPU_RAG_SPEC_PAGED_MIN_ACCEPT" in env:
+            ma = float(env["TPU_RAG_SPEC_PAGED_MIN_ACCEPT"])
+            if not 0.0 <= ma <= 1.0:
+                raise ValueError(
+                    f"TPU_RAG_SPEC_PAGED_MIN_ACCEPT={ma}: an acceptance-"
+                    "rate floor must lie in [0, 1]"
+                )
+            engine = dataclasses.replace(engine, spec_paged_min_accept=ma)
         if "TPU_RAG_WARM_FULL_LADDER" in env:
             flag = env["TPU_RAG_WARM_FULL_LADDER"]
             if flag not in ("0", "1"):

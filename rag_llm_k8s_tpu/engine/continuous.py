@@ -60,7 +60,16 @@ from rag_llm_k8s_tpu.engine.engine import (
     param_avals,
 )
 from rag_llm_k8s_tpu.engine.kv_pool import KVBlockPool, NULL_BLOCK, PoolExhausted
-from rag_llm_k8s_tpu.engine.sampling import sample_token_per_row
+from rag_llm_k8s_tpu.engine.sampling import (
+    accept_drafts,
+    sample_targets_per_row,
+    sample_token_per_row,
+)
+from rag_llm_k8s_tpu.engine.speculative import (
+    adaptive_draft_len,
+    fold_acceptance,
+    prompt_lookup_draft,
+)
 from rag_llm_k8s_tpu.models.llama import (
     LlamaModel,
     make_kv_arena,
@@ -104,6 +113,12 @@ class _Slot:
     admit_seq: int = 0
     prompt_len: int = 0
     shared_tokens: int = 0  # tokens served by ref-shared prefix blocks
+    # speculative decoding (spec_paged): the row's draft corpus — the
+    # assembled prompt + every emitted token, the history prompt-lookup
+    # matches over — and the decayed acceptance EMA that drives its
+    # adaptive draft length (None = no evidence yet; engine/speculative.py)
+    history: List[int] = field(default_factory=list)
+    spec_ema: Optional[float] = None
 
 
 class ContinuousEngine:
@@ -230,6 +245,36 @@ class ContinuousEngine:
             # PLANNED, not oldest-inserted.
             self._chunk_regs: "OrderedDict[str, tuple]" = OrderedDict()
             self._chunk_reg_tokens = 0
+        # ---- speculative decoding (paged draft-and-verify; ISSUE 13) ----
+        # Each sync window may run as ONE multi-token VERIFY step instead
+        # of decode_sync_steps single-token steps: the host drafts up to
+        # spec_K continuation tokens per row by prompt-lookup over the
+        # row's own history (the retrieved chunks ARE the draft corpus —
+        # no draft model), the device feeds last_tok + drafts through the
+        # block tables in one chunked forward, and target-matching
+        # acceptance keeps the longest prefix equal to what the vanilla
+        # step would have sampled — greedy AND seeded streams stay
+        # byte-identical by construction. docs/SPECULATIVE.md.
+        self.spec_on = bool(getattr(engine_config, "spec_paged", False))
+        if self.spec_on:
+            if not self.paged:
+                raise ValueError(
+                    "spec_paged=True requires kv_paged=True — the verify "
+                    "step writes drafted positions through block tables "
+                    "(the dense continuous path does not speculate)"
+                )
+            self.spec_K = int(engine_config.spec_paged_tokens)
+            if self.spec_K < 1:
+                raise ValueError(
+                    f"spec_paged_tokens={self.spec_K}: expected >= 1"
+                )
+            self.spec_ngram = max(1, int(engine_config.spec_ngram))
+            self.spec_min_accept = float(engine_config.spec_paged_min_accept)
+            if not 0.0 <= self.spec_min_accept <= 1.0:
+                raise ValueError(
+                    f"spec_paged_min_accept={self.spec_min_accept}: an "
+                    "acceptance-RATE floor must lie in [0, 1]"
+                )
         self.params, fused = maybe_fuse_params(params, engine_config, mesh)
         self.params, quantized = maybe_quantize_params(self.params, engine_config)
         self.model = LlamaModel(
@@ -364,6 +409,10 @@ class ContinuousEngine:
                 lambda did=did: self._arena_device_bytes.get(did, 0.0),
                 device=did,
             )
+        # (the rag_spec_tokens_total / rag_spec_acceptance_rate families
+        # are registered by the SERVICE — server/app.py — off the shared
+        # EngineStats fields, so they exist uniformly in every serving
+        # mode; standalone engines expose the same numbers via .stats)
 
     def warmup(self, batch_sizes=None, buckets=None):
         """AOT-compile every executable serving will hit (readiness gating).
@@ -390,6 +439,11 @@ class ContinuousEngine:
                     self._get("prefill", S, n)
                     self._get("insert", S, n)
         self._get("step_paged" if self.paged else "step", self.sync_steps)
+        if self.spec_on:
+            # the verify executable AND the plain window both serve under
+            # speculation (windows where no row drafts fall back), so warm
+            # both — the first quoting answer must not pay a compile
+            self._get("verify_paged", self.spec_K)
 
     def _put(self, x, sharding=None):
         """Place a host/device value to match a lowered aval's sharding;
@@ -492,6 +546,8 @@ class ContinuousEngine:
                 fn = self._build_chunk_splice(S)  # S carries the block count
             elif kind == "boundary_px":
                 fn = self._build_boundary_px_paged(S)  # S carries the window
+            elif kind == "verify_paged":
+                fn = self._build_verify_paged(S)  # S carries the draft count K
             else:
                 fn = self._build_insert(S, n)
             self._m_compile_events.inc()
@@ -885,6 +941,12 @@ class ContinuousEngine:
             request_id=request_id, tokens=[tok0], remaining=max_new_c - 1,
             active=True, kv_ub=total, admit_seq=self._admit_seq,
             prompt_len=total, shared_tokens=shared_tok,
+            # spec draft corpus: a prefixed admission only carries the
+            # SUFFIX token ids (the prefix is a KV descriptor — its ids
+            # never reach the engine), so the corpus starts there and
+            # grows with the emitted stream; drafting still fires on
+            # self-repeats, just without the spliced context's text
+            history=(list(suffix) + [tok0]) if self.spec_on else [],
         )
         self.stats.decode_tokens += 1
         return row, None
@@ -1424,6 +1486,116 @@ class ContinuousEngine:
             jax.ShapeDtypeStruct((B, 2), jnp.uint32, sharding=rep),
         ).compile()
 
+    def _build_verify_paged(self, K: int):
+        """The speculative VERIFY executable (ISSUE 13): one device call
+        feeds every active row ``last_tok`` + its ``K`` drafted tokens
+        through the paged chunked model — the masked-plane scatter writes
+        all ``K+1`` positions through each row's block table (per-row
+        vector base + lane offsets, the same write the admission chunk
+        path uses), the paged chunk kernel attends each lane with offset
+        causality, and ``K+1`` logit planes come back instead of one.
+
+        Acceptance happens ON DEVICE so the host fetch stays one
+        round-trip: plane ``j``'s TARGET is what the vanilla step loop
+        would have sampled at that position — argmax for greedy, the
+        (seed, position)-keyed categorical draw for sampling (the fold
+        sequence continues exactly, so seeded streams match bit-for-bit;
+        engine/sampling.py). A row accepts the longest draft prefix equal
+        to its targets and emits the target at the first mismatch (the
+        correction) or the bonus target on full acceptance — the emitted
+        stream is the vanilla stream BY CONSTRUCTION, speculation only
+        changes how many tokens one window retires.
+
+        Rejected lanes need no explicit retraction: their KV writes land
+        beyond the advanced ``kv_len`` frontier, where no kernel window
+        ever reads and the next window overwrites — the same masking
+        discipline that makes blind multi-step sync windows correct.
+        Lanes past a row's own ``n_drafts`` (rows draft different lengths
+        in one window) write junk into mapped-but-beyond-frontier slots
+        or, past the row's table, the NULL block (the llama.py scatter
+        parks out-of-table positions there). Inactive rows park wholesale
+        at the null block, exactly like the plain step."""
+        cfg, dt, sampling = self.config, self.dtypes, self.sampling
+        model = self.model_chunked_paged
+        eos_ids = cfg.eos_token_ids
+        B = self.B
+        S = K + 1
+        Tmax = self.MB * self.block_size
+        kv_quant = self.kv_quant
+        i32 = jnp.int32
+        from rag_llm_k8s_tpu.models.llama import KVCache
+
+        def verify(params, cache_t, tables, kv_len, last_tok, active,
+                   rng_keys, drafts, n_drafts):
+            wi = jnp.where(active, kv_len, 0)  # inactive rows park at 0
+            # inactive rows' junk routes to the NULL block (same rule as
+            # the plain step: an EOS'd row's table is still mapped until
+            # the host drains, and logical block 0 can be ref-shared)
+            tables_eff = jnp.where(active[:, None], tables, NULL_BLOCK)
+            nd = jnp.where(active, n_drafts, 0)
+            fed = jnp.concatenate([last_tok[:, None], drafts], axis=1)
+            pos = wi[:, None] + jnp.arange(S, dtype=i32)[None, :]  # [B, S]
+            # the deepest VALID lane (j = nd) attends keys <= wi + nd:
+            # kv_len = wi + nd + 1 caps every row's window there; junk
+            # lanes beyond see a truncated window and junk logits nobody
+            # samples from
+            logits, cache = model.apply(
+                {"params": params}, fed, pos, KVCache(*cache_t),
+                jnp.zeros((B,), i32), wi + 1 + nd, wi,
+                block_tables=tables_eff,
+            )
+            # plane j samples the token that will sit at position
+            # wi + j + 1 — fold EXACTLY the key the vanilla step would
+            # have folded for it ((seed, position) discipline)
+            keys = jax.vmap(
+                jax.vmap(jax.random.fold_in, in_axes=(None, 0))
+            )(rng_keys, pos + 1)  # [B, S, 2]
+            targets = sample_targets_per_row(keys, logits, sampling)
+            m, emitted = accept_drafts(drafts, targets, nd)
+            jj = jnp.arange(S, dtype=i32)[None, :]
+            is_eos = _isin(emitted, eos_ids)  # [B, S] elementwise
+            hit_eos = jnp.any(is_eos & (jj <= m[:, None]), axis=1)
+            # frontier: last_tok's KV at wi + accepted drafts' at
+            # wi+1..wi+m are valid; the correction token (plane m) is the
+            # new last_tok, written next window at the new frontier —
+            # identical bookkeeping to m+1 vanilla steps
+            kv_len = jnp.where(
+                active, jnp.minimum(wi + m + 1, Tmax - 1), kv_len
+            )
+            new_last = jnp.take_along_axis(emitted, m[:, None], axis=1)[:, 0]
+            last_tok = jnp.where(active, new_last, last_tok)
+            n_emit = jnp.where(active, m + 1, 0)
+            active = active & ~hit_eos
+            out = (
+                (cache.k, cache.v, cache.k_scale, cache.v_scale)
+                if kv_quant == "int8" else (cache.k, cache.v)
+            )
+            # [S, B] planes mirror the plain step's [k, B] fetch layout
+            return (
+                out, kv_len, last_tok, emitted.T, n_emit, is_eos.T,
+                m, active,
+            )
+
+        rep = self.mesh.replicated if self.mesh is not None else None
+        out_shardings = (
+            (self._arena_shardings(), rep, rep, rep, rep, rep, rep, rep)
+            if self.mesh is not None else None
+        )
+        # tables/rng_keys/drafts are host-fed per window, never donated
+        return jax.jit(
+            verify, donate_argnums=(1, 3, 4, 5), out_shardings=out_shardings
+        ).lower(
+            param_avals(self.params),
+            self._arena_avals(),
+            jax.ShapeDtypeStruct((B, self.MB), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), bool, sharding=rep),
+            jax.ShapeDtypeStruct((B, 2), jnp.uint32, sharding=rep),
+            jax.ShapeDtypeStruct((B, K), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+        ).compile()
+
     def _build_prefix_scatter(self, P: int):
         """Scatter a ``CachedPrefix``'s splice-buffer planes into pool
         blocks: a static loop over the buffer's ``P // block_size`` slabs,
@@ -1866,14 +2038,19 @@ class ContinuousEngine:
             "ok" if self.kv_pool.can_alloc(want) else "never"
         )
 
-    def _ensure_decode_blocks(self) -> None:
+    def _ensure_decode_blocks(
+        self, horizon: "Optional[Dict[int, int]]" = None
+    ) -> None:
         """Grow every active row's table to cover the next sync window
         (positions up to ``kv_ub + k``) BEFORE the device call — a write
         landing in an unmapped block would vanish into the null block and
-        corrupt the stream one step later. Exhaustion preempts the
-        NEWEST-admitted rows (their emitted tokens return to the scheduler,
-        which resubmits once blocks free — vLLM-style recompute preemption)
-        until the remaining rows fit."""
+        corrupt the stream one step later. ``horizon`` overrides the
+        per-row token horizon (speculative verify windows write
+        ``n_drafts + 1`` positions per row, not ``sync_steps`` — rows
+        draft different lengths, so the map is per-row). Exhaustion
+        preempts the NEWEST-admitted rows (their emitted tokens return to
+        the scheduler, which resubmits once blocks free — vLLM-style
+        recompute preemption) until the remaining rows fit."""
         k = self.sync_steps
         bs = self.block_size
         while True:
@@ -1885,8 +2062,9 @@ class ContinuousEngine:
                 # ownership list IS the count — no B x MB table rescan on
                 # the hot per-window path
                 have = len(self._slot_blocks[row])
+                h = k if horizon is None else horizon.get(row, 1)
                 need_total = min(
-                    -(-(slot.kv_ub + k) // bs), self.MB
+                    -(-(slot.kv_ub + h) // bs), self.MB
                 )
                 if need_total > have:
                     short.append((slot.admit_seq, row, need_total - have, have))
@@ -2279,6 +2457,10 @@ class ContinuousEngine:
                     request_id=rid, tokens=[tok0], remaining=max_new_c - 1,
                     active=True, kv_ub=len(p), admit_seq=self._admit_seq,
                     prompt_len=len(p),
+                    # spec draft corpus: the full assembled prompt (head +
+                    # retrieved chunks arrive through the scheduler as one
+                    # token list) + the first sampled token
+                    history=(list(p) + [tok0]) if self.spec_on else [],
                 )
                 self.stats.decode_tokens += 1
                 results[i] = (row, None)
@@ -2298,8 +2480,23 @@ class ContinuousEngine:
     def step(self) -> List[Tuple[int, List[int]]]:
         """``decode_sync_steps`` decode steps for every active slot in one
         device call + one host fetch. Returns completed requests as
-        ``(request_id, tokens)`` and frees their slots."""
+        ``(request_id, tokens)`` and frees their slots.
+
+        With ``spec_paged`` enabled, a window where drafting is expected
+        to WIN runs as ONE multi-token verify step instead
+        (``_step_verify`` — up to ``spec_K + 1`` tokens retired per row
+        per fetch). The routing is throughput-gated, not draft-gated: a
+        verify call retires ``1 + accepted`` tokens per row while a plain
+        window retires ``sync_steps`` per row, so one persistently-
+        quoting row in a large batch must not collapse the k-step
+        amortization for every non-drafting batchmate
+        (``_verify_worthwhile``). Windows that don't clear the bar (and
+        all no-draft windows) keep the plain path untouched."""
         faults.maybe_fail("decode_step")
+        if self.spec_on and self.paged:
+            drafts = self._draft_for_slots()
+            if any(drafts.values()) and self._verify_worthwhile(drafts):
+                return self._step_verify(drafts)
         k = self.sync_steps
         if self.paged:
             # map the blocks this window will write BEFORE dispatch (an
@@ -2349,6 +2546,8 @@ class ContinuousEngine:
                     finished = True  # EOS token itself is not emitted
                     break
                 slot.tokens.append(int(tok_h[j, i]))
+                if self.spec_on:
+                    slot.history.append(int(tok_h[j, i]))
                 slot.remaining -= 1
                 self.stats.decode_tokens += 1
                 if slot.remaining <= 0:
@@ -2373,6 +2572,171 @@ class ContinuousEngine:
         self._m_step_drain.observe(time.perf_counter() - t_fetch)
         flight.emit(
             "sync_window_close", steps=k, done=len(done),
+            duration_ms=round((time.perf_counter() - t0) * 1e3, 3),
+        )
+        return done
+
+    # ------------------------------------------------------------------
+    # speculative decoding (spec_paged; docs/SPECULATIVE.md)
+    # ------------------------------------------------------------------
+    def _draft_for_slots(self) -> Dict[int, List[int]]:
+        """This window's draft per active row: prompt-lookup over the
+        row's own history (assembled prompt + emitted — the retrieved
+        chunks ARE the corpus), length-capped by the row's decayed
+        acceptance EMA (low-acceptance rows degrade to K=1;
+        engine/speculative.py), its remaining token budget (tokens past
+        it are discarded anyway) and the slot ladder's top (a draft whose
+        accepted frontier would overrun ``Tmax`` can't be mapped). An
+        empty list means the row takes a plain decode step — inside the
+        verify window when batchmates drafted, on the ordinary sync-step
+        path when nobody did."""
+        Tmax = self.MB * self.block_size
+        out: Dict[int, List[int]] = {}
+        for row, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            k_row = adaptive_draft_len(
+                slot.spec_ema, self.spec_K, self.spec_min_accept
+            )
+            k_row = min(k_row, slot.remaining - 1, Tmax - 2 - slot.kv_ub)
+            if k_row < 1:
+                out[row] = []
+                continue
+            out[row] = prompt_lookup_draft(
+                slot.history, self.spec_ngram, k_row
+            )
+        return out
+
+    def _verify_worthwhile(self, drafts: Dict[int, List[int]]) -> bool:
+        """Should this window verify instead of running the plain path?
+        A verify window is ONE device call retiring ``1 + accepted``
+        tokens per row; a plain window retires ``sync_steps`` per row per
+        call. Compare the EMA-expected verify yield against the plain
+        window's certain ``k × active`` — under ``sync_steps == 1`` any
+        draft wins (the verify can only add tokens), but at ``k > 1`` a
+        lone quoting row must not cost every batchmate ``k - 1`` tokens
+        per fetch. Fresh rows (no EMA) count optimistically — the first
+        verify measures them."""
+        k = self.sync_steps
+        if k <= 1:
+            return True
+        n_active = 0
+        expected = 0.0
+        for row, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            n_active += 1
+            d = drafts.get(row)
+            if d:
+                ema = 1.0 if slot.spec_ema is None else slot.spec_ema
+                expected += 1.0 + ema * len(d)
+            else:
+                expected += 1.0
+        return expected >= n_active * k
+
+    def _step_verify(
+        self, drafts: Dict[int, List[int]]
+    ) -> List[Tuple[int, List[int]]]:
+        """One speculative sync window: grow tables for each row's OWN
+        horizon (``n_drafts + 1`` writes — exhaustion preempts newest
+        rows exactly like a plain window; a preempted row's drafts die
+        with its slot), run the verify executable, then drain up to
+        ``n_emit`` tokens per row from the fetched planes. The drain is
+        the plain window's loop with the window bound per-row instead of
+        ``k`` — EOS/budget retirement, block release and preemption
+        resume are shared, so every recovery path sees one shape of
+        state."""
+        K = self.spec_K
+        self._ensure_decode_blocks(
+            {row: len(d) + 1 for row, d in drafts.items()}
+        )
+        if not self.has_active():
+            return []  # everything was preempted: nothing to verify
+        d_arr = np.zeros((self.B, K), np.int32)
+        nd = np.zeros((self.B,), np.int32)
+        for row, d in drafts.items():
+            if d and self.slots[row].active:
+                d_arr[row, : len(d)] = d
+                nd[row] = len(d)
+        n_active = sum(1 for s in self.slots if s.active)
+        flight.emit(
+            "spec_draft", rows=int((nd > 0).sum()), active=n_active,
+            drafted=int(nd.sum()),
+        )
+        flight.emit("sync_window_open", steps=1, active=n_active, spec=1)
+        t0 = time.perf_counter()
+        (self._cache, self._kv_len, self._last_tok, toks, n_emit, eoss,
+         acc, self._active) = self._get("verify_paged", K)(
+            self.params, self._cache, self._device_tables(),
+            self._kv_len, self._last_tok, self._active, self._rng_keys,
+            self._put(jnp.asarray(d_arr)), self._put(jnp.asarray(nd)),
+        )
+        self.steps += 1
+        tok_h = np.asarray(toks)  # [K+1, B] emitted planes
+        ne_h = np.asarray(n_emit)  # [B] valid planes per row (m + 1)
+        t_fetch = time.perf_counter()
+        eos_h = np.asarray(eoss)
+        acc_h = np.asarray(acc)  # [B] accepted prefix lengths
+        emitted_total = int(ne_h.sum())
+        # per-ROW per-token latency, like the plain window's window/k:
+        # the mean row advanced emitted_total / n_active tokens in this
+        # wall-clock interval
+        self._m_itl.observe(
+            (t_fetch - t0) * n_active / max(emitted_total, 1)
+        )
+        self._m_step_device.observe(t_fetch - t0)
+        Tmax = self.MB * self.block_size
+        done: List[Tuple[int, List[int]]] = []
+        deactivate = []
+        drafted_total = int(nd.sum())
+        accepted_total = 0
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            offered, m = int(nd[i]), int(acc_h[i])
+            accepted_total += m
+            slot.spec_ema = fold_acceptance(slot.spec_ema, offered, m)
+            # the exact new frontier (not an upper bound): the device
+            # advanced kv_len by exactly n_emit valid positions
+            slot.kv_ub = min(slot.kv_ub + int(ne_h[i]), Tmax - 1)
+            finished = False
+            for j in range(int(ne_h[i])):
+                if eos_h[j, i]:
+                    finished = True  # EOS token itself is not emitted
+                    break
+                slot.tokens.append(int(tok_h[j, i]))
+                slot.history.append(int(tok_h[j, i]))
+                slot.remaining -= 1
+                self.stats.decode_tokens += 1
+                if slot.remaining <= 0:
+                    finished = True  # tokens past the budget discarded
+                    break
+            if finished:
+                done.append((slot.request_id, slot.tokens))
+                flight.emit(
+                    "eos", slot.request_id,
+                    reason="budget" if slot.remaining <= 0 else "eos",
+                    n_tokens=len(slot.tokens),
+                )
+                slot.active = False
+                deactivate.append(i)
+        self.stats.spec_verify_steps += 1
+        self.stats.spec_drafted_rows += int((nd > 0).sum())
+        self.stats.spec_drafted_tokens += drafted_total
+        self.stats.spec_accepted_tokens += accepted_total
+        self.stats.spec_emitted_tokens += emitted_total
+        flight.emit(
+            "spec_verify", drafted=drafted_total, accepted=accepted_total,
+            rejected=drafted_total - accepted_total, emitted=emitted_total,
+        )
+        if deactivate:
+            mask = np.ones(self.B, bool)
+            mask[deactivate] = False
+            self._active = self._active & self._put(jnp.asarray(mask))
+            self._retire_rows(deactivate)  # paged: blocks back to the pool
+        self._m_step_drain.observe(time.perf_counter() - t_fetch)
+        flight.emit(
+            "sync_window_close", steps=1, done=len(done),
             duration_ms=round((time.perf_counter() - t0) * 1e3, 3),
         )
         return done

@@ -152,6 +152,18 @@ class EngineStats:
     # e2e bench to report)
     spec_verify_steps: int = 0
     spec_emitted_tokens: int = 0
+    # paged continuous draft-and-verify (engine/speculative.py): draft
+    # tokens OFFERED to verify steps and the subset ACCEPTED (emitted as
+    # drafted); rejected = drafted - accepted. The one-shot path cannot
+    # split these (its matcher runs on device, acceptance is folded into
+    # emitted/verify_steps), so they move only under spec_paged.
+    spec_drafted_tokens: int = 0
+    spec_accepted_tokens: int = 0
+    # (row, verify-window) pairs that OFFERED drafts — the denominator of
+    # the honest mean-accepted-length read: accepted_tokens/drafted_rows
+    # (emitted/verify_steps is batch-summed and counts corrections, so it
+    # floors at the active-row count even when acceptance is zero)
+    spec_drafted_rows: int = 0
     # KV prefix cache: prompt tokens whose prefill was SKIPPED because their
     # KV was spliced from a cached block (prefill_tokens counts only tokens
     # actually computed — the two sum to the logical prompt-token total)

@@ -106,3 +106,59 @@ def sample_token_per_row(
     return jax.vmap(
         lambda k, row: jax.random.categorical(k, row, axis=-1)
     )(keys, scaled).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# speculative verify-step acceptance (paged draft-and-verify)
+# ---------------------------------------------------------------------------
+
+
+def sample_targets_per_row(
+    keys: jax.Array,  # [B, S, 2] uint32 — plane j's key = fold(row key, pos)
+    logits: jax.Array,  # [B, S, V] fp32 — one plane per fed token
+    sampling: SamplingConfig,
+) -> jax.Array:
+    """The verify step's TARGET tokens ``[B, S]``: what the vanilla
+    continuous step would have sampled at each plane. Plane ``j``'s draw
+    uses exactly the key the step loop would have folded for that token's
+    position, so greedy (argmax) AND seeded sampling verify steps emit the
+    byte-identical stream — speculative acceptance below is "does the
+    draft equal this target", one rule for both modes (the one-shot
+    engine's rejection-sampling rule is only needed for UNKEYED draws;
+    the continuous engine's draws are (seed, position)-deterministic, so
+    target matching is exact, not just distribution-preserving)."""
+    scaled = _prepared_logits(logits, sampling)
+    if scaled is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    B, S, V = logits.shape
+    flat = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row, axis=-1)
+    )(keys.reshape(B * S, 2), scaled.reshape(B * S, V))
+    return flat.reshape(B, S).astype(jnp.int32)
+
+
+def accept_drafts(
+    drafts: jax.Array,  # [B, K] int32 — proposed continuations
+    targets: jax.Array,  # [B, K+1] int32 — the model's own tokens per plane
+    n_drafts: jax.Array,  # [B] int32 — real drafts per row (<= K)
+):
+    """Per-row longest-prefix acceptance: row ``b`` accepts drafts while
+    they equal the model's targets (and stay within its own ``n_drafts``),
+    then emits the target at the first mismatch position — the correction
+    (or, on full acceptance, the bonus target from the last plane). Returns
+    ``(m, emitted)``: ``m [B]`` accepted prefix lengths and ``emitted
+    [B, K+1]`` where planes ``0..m`` are the row's emitted tokens (plane
+    ``m`` is the correction/bonus; planes past ``m`` are junk the host
+    never reads — it drains exactly ``m + 1`` per row). Shape-static and
+    branch-free, safe inside the verify executable."""
+    B, K = drafts.shape
+    i32 = jnp.int32
+    j = jnp.arange(K, dtype=i32)[None, :]
+    ok = (drafts == targets[:, :K]) & (j < n_drafts[:, None])
+    acc = jnp.cumprod(ok.astype(i32), axis=1)
+    m = jnp.sum(acc, axis=1)  # [B] in [0, n_drafts]
+    jj = jnp.arange(K + 1, dtype=i32)[None, :]
+    ext = jnp.concatenate([drafts, jnp.zeros((B, 1), i32)], axis=1)
+    corr = jnp.take_along_axis(targets, m[:, None], axis=1)  # [B, 1]
+    emitted = jnp.where(jj == m[:, None], corr, ext)
+    return m, emitted

@@ -1,0 +1,126 @@
+"""Scheduler-side drafting for paged speculative decoding (host half).
+
+The paged continuous engine's draft-and-verify loop splits cleanly in two:
+the DEVICE half is one multi-token verify executable per sync window
+(``ContinuousEngine._build_verify_paged`` — K+1 fed tokens per row through
+the block tables, K+1 logit planes back, target-matching acceptance inside
+the program), and the HOST half — this module — decides *what* to draft
+between windows:
+
+- :func:`prompt_lookup_draft` — the draft source. RAG-grounded answers
+  heavily copy their retrieved context (SIFT's observation; the one-shot
+  engine's device-side matcher exploits the same structure), so the
+  request's own token history — assembled prompt (head + retrieved
+  chunks) + everything emitted so far — IS the draft corpus: propose the
+  tokens that followed the most recent earlier occurrence of the trailing
+  ``ngram``-gram. No draft model, no extra weights in HBM, no second
+  forward — drafting is a numpy scan over a few KB of host ints.
+- :func:`adaptive_draft_len` / :func:`fold_acceptance` — the per-row
+  adaptive-K controller. Every verify window folds each row's measured
+  acceptance fraction (accepted / offered) into a decayed per-row EMA;
+  the next window's draft length scales with it, so a row whose output
+  does NOT quote its context degrades gracefully to K=1 (a 2-wide verify
+  costs ~one decode step — decode is weight-bandwidth-bound, width is
+  nearly free) instead of paying a wide verify that rejects everything.
+
+Correctness lives entirely in the verify step's acceptance rule
+(``engine/sampling.py``: accept while the draft equals the model's OWN
+(seed, position)-keyed target), so nothing here can change what a request
+emits — a wrong draft costs latency, never bytes. docs/SPECULATIVE.md.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "SPEC_EMA_DECAY",
+    "adaptive_draft_len",
+    "fold_acceptance",
+    "prompt_lookup_draft",
+]
+
+#: Per-row acceptance EMA decay: ~5-window memory. Short on purpose — a
+#: RAG answer often alternates between quoting spans (high acceptance) and
+#: free-form connective text (low); a long memory would hold K low through
+#: an entire quoted span.
+SPEC_EMA_DECAY = 0.8
+
+
+def prompt_lookup_draft(
+    history: Sequence[int], ngram: int, k: int
+) -> List[int]:
+    """Up to ``k`` draft tokens for a row whose token history (assembled
+    prompt + emitted) is ``history``: the continuation of the most recent
+    EARLIER occurrence of the trailing ``ngram``-gram, ``[]`` when the
+    gram never repeats (the row then takes a plain decode step inside the
+    verify window — zero drafts is the graceful floor).
+
+    Host mirror of the one-shot engine's device matcher
+    (``InferenceEngine._make_gen_spec``): same last-occurrence rule, same
+    gram size (``EngineConfig.spec_ngram``); here the scan is a couple of
+    vectorized numpy passes per row per window instead of device lanes.
+    A continuation is truncated at the frontier rather than rejected —
+    a short draft still saves its accepted length."""
+    n = len(history)
+    if k <= 0 or ngram <= 0 or n < ngram + 1:
+        return []
+    h = np.asarray(history, dtype=np.int64)
+    tail = h[-ngram:]
+    # candidate END positions j in [0, n-2]: the gram occupies
+    # [j-ngram+1, j] and must end strictly before the frontier gram (an
+    # occurrence ending at n-1 is the frontier matching itself — its
+    # continuation is unwritten future, the one-shot matcher's pad trap)
+    ok = np.ones(n - 1, dtype=bool)
+    for i in range(ngram):
+        col = np.empty(n - 1, dtype=np.int64)
+        col[:i] = -1  # j < i cannot hold a full gram
+        if i:
+            col[i:] = h[: n - 1 - i]
+        else:
+            col[:] = h[: n - 1]
+        ok &= col == tail[ngram - 1 - i]
+    idx = np.nonzero(ok)[0]
+    if idx.size == 0:
+        return []
+    j = int(idx[-1])
+    return [int(t) for t in h[j + 1 : j + 1 + k]]
+
+
+def adaptive_draft_len(
+    ema: Optional[float], k_max: int, min_accept: float
+) -> int:
+    """This window's draft length for a row with acceptance EMA ``ema``:
+
+    - no evidence yet (``None``) → the full ``k_max`` (optimistic start —
+      the first window measures; a grounded answer's quoting shows up
+      immediately);
+    - EMA below ``min_accept`` → 1 (the graceful floor: one drafted token
+      keeps the row probing at ~zero cost, so a row that STARTS quoting
+      again recovers within a few windows);
+    - otherwise → ``round(ema * k_max)``, clamped to ``[1, k_max]`` — the
+      draft length tracks how much of the last windows' drafts survived.
+    """
+    if k_max < 1:
+        return 0
+    if ema is None:
+        return k_max
+    if ema < min_accept:
+        return 1
+    return max(1, min(k_max, int(round(ema * k_max))))
+
+
+def fold_acceptance(
+    ema: Optional[float], offered: int, accepted: int
+) -> Optional[float]:
+    """Fold one verify window's measured acceptance fraction into a row's
+    decayed EMA (identity when the window offered nothing — a no-match
+    window is no evidence about acceptance)."""
+    if offered <= 0:
+        return ema
+    r = accepted / offered
+    if ema is None:
+        return r
+    return SPEC_EMA_DECAY * ema + (1.0 - SPEC_EMA_DECAY) * r

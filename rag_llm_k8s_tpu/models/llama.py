@@ -721,8 +721,15 @@ class Attention(nn.Module):
             pos = jnp.asarray(write_index, jnp.int32).reshape(B, -1)
             if self.chunked and S > 1:
                 pos = pos[:, :1] + jnp.arange(S, dtype=jnp.int32)[None, :]
-            blk = jnp.clip(pos // bs_len, 0, MB - 1)
+            blk_raw = pos // bs_len
+            blk = jnp.clip(blk_raw, 0, MB - 1)
             phys = jnp.take_along_axis(block_tables.astype(jnp.int32), blk, axis=1)
+            # positions past the table park in the NULL block (physical 0)
+            # — clipping into logical block MB-1 would overwrite valid KV
+            # at the top of the slot ladder (a speculative verify window's
+            # junk lanes can run past a row's last logical block; so could
+            # any chunked write near the window end)
+            phys = jnp.where(blk_raw < MB, phys, 0)
             off = pos % bs_len
             flat_phys = phys.reshape(-1)  # [B*S]
             flat_off = off.reshape(-1)

@@ -88,6 +88,12 @@ EVENTS: Dict[str, str] = {
                 "n_emitted tokens carried over)",
     "complete": "request delivered (n_tokens, stream_fnv — FNV-1a over "
                 "the emitted token stream, the byte-consistency anchor)",
+    "spec_draft": "a speculative sync window drafted continuations by "
+                  "prompt-lookup over each row's history (rows drafting, "
+                  "active rows, drafted tokens total)",
+    "spec_verify": "a multi-token verify step judged its window's drafts "
+                   "(drafted, accepted, rejected, emitted token counts — "
+                   "accepted/drafted is the window's acceptance rate)",
     # -- KV block pool (engine/kv_pool.py) -------------------------------
     "pool_alloc": "physical KV blocks taken from the pool (blocks, free "
                   "remaining)",

@@ -77,6 +77,10 @@ _RULES: Tuple[Tuple[re.Pattern, str], ...] = tuple(
         # KV-tiering leg (ISSUE 8): servable-capacity multiplier at fixed
         # HBM and the fraction of swap-ins hidden under decode
         (r"effective_capacity_x|hide_rate", "higher"),
+        # paged-speculation leg (ISSUE 13): mean accepted draft length per
+        # verify window — shrinkage means the draft source stopped firing
+        # (the speedups themselves match the "speedup" rule above)
+        (r"accept_len_mean", "higher"),
         # chunk-reuse leg (ISSUE 12): prefill tokens skipped on the
         # shuffled-composition stream — shrinkage is a regression; the
         # measured logit error must not grow past its pin either

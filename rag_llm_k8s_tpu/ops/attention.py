@@ -1421,7 +1421,16 @@ def paged_chunk_attention(
     via the table with offset causality. The chunk's own K/V must already
     be scattered into the row's blocks (the model writes before attending,
     exactly like the dense chunk path). ``write_index`` is per-row — paged
-    rows are right-padded, so rows at different depths chunk together."""
+    rows are right-padded, so rows at different depths chunk together.
+
+    This is also THE multi-position paged DECODE kernel: the speculative
+    verify step (``ContinuousEngine._build_verify_paged``) feeds every
+    row ``last_tok`` + its K drafted tokens as one S = K+1 "chunk" at the
+    row's own frontier (``write_index = kv_len``, per-row), so a verify
+    window streams each row's live blocks ONCE for K+1 query lanes —
+    decode is bandwidth-bound, which is exactly why a K+1-wide verify
+    costs ~one decode step. Junk lanes past a row's real draft count are
+    masked by its ``kv_len`` window, never by extra kernel logic."""
     B, S, H, hd = q.shape
     L, N, K, bs, _ = k_arena.shape
     G = H // K

@@ -400,6 +400,52 @@ class RagService:
                     fn=lambda: self._engine_stat("spec_verify_steps"))
         reg.counter("engine_spec_emitted_tokens",
                     fn=lambda: self._engine_stat("spec_emitted_tokens"))
+        # paged continuous draft-and-verify (TPU_RAG_SPEC_PAGED,
+        # docs/SPECULATIVE.md): draft-token outcomes summed over the
+        # serving engines — families exist in every mode (zeros while
+        # speculation is off) so dashboards stay uniform
+        spec_fam = reg.labeled_counter(
+            "rag_spec_tokens_total",
+            "draft tokens judged by paged verify steps (outcome: accepted "
+            "— emitted exactly as drafted; rejected — replaced by the "
+            "correction target)",
+        )
+        spec_fam.labels_callback(
+            lambda: self._engine_stat("spec_accepted_tokens"),
+            outcome="accepted",
+        )
+        spec_fam.labels_callback(
+            lambda: (
+                self._engine_stat("spec_drafted_tokens")
+                - self._engine_stat("spec_accepted_tokens")
+            ),
+            outcome="rejected",
+        )
+        sched_eng = getattr(self.scheduler, "engine", None)
+        if int(getattr(sched_eng, "B", 0) or 0) > 0:
+            # continuous mode only: a labeled family with ZERO children
+            # would appear in the JSON snapshot but not the text
+            # exposition (the equivalence test_obs pins), so the per-row
+            # family exists exactly where rows exist
+            spec_rows = reg.labeled_gauge(
+                "rag_spec_acceptance_rate",
+                "per-slot decayed draft-acceptance rate (accepted/offered "
+                "EMA; 0 while the slot is empty or has no evidence) — the "
+                "adaptive-K controller's input: rows below "
+                "TPU_RAG_SPEC_PAGED_MIN_ACCEPT degrade to K=1",
+            )
+            for i in range(int(sched_eng.B)):
+                # reading the slot list from the scrape thread is safe:
+                # the engine replaces slots wholesale (never mutates one
+                # into an inconsistent state) and a stale EMA read is
+                # gauge-grade
+                spec_rows.labels_callback(
+                    lambda i=i, e=sched_eng: (
+                        float(e.slots[i].spec_ema or 0.0)
+                        if e.slots[i].active else 0.0
+                    ),
+                    row=str(i),
+                )
         # KV prefix cache: prompt tokens whose prefill was skipped because
         # their KV spliced from a cached block — computed (prefill_tokens)
         # + skipped = logical prompt total

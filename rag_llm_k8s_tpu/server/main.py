@@ -142,14 +142,20 @@ def build_service():
 
     if config.engine.batching == "continuous":
         if config.engine.speculative == "prompt_lookup":
-            # the slot-based engine has no speculative path; without this
-            # the EXPLICIT knob would be silently inert behind the scheduler
-            # (the default "auto" simply never engages here — no warning)
+            # TPU_RAG_SPECULATIVE governs the ONE-SHOT engine only;
+            # without this the EXPLICIT knob would be silently inert
+            # behind the scheduler (the default "auto" simply never
+            # engages here — no warning). The continuous PAGED engine has
+            # its own draft-and-verify under TPU_RAG_SPEC_PAGED
+            # (docs/SPECULATIVE.md) — point the operator at it.
             logger.warning(
                 "TPU_RAG_SPECULATIVE='prompt_lookup' is configured but "
                 "TPU_RAG_BATCHING='continuous' routes requests through the "
-                "slot engine, which does not speculate — use "
-                "batching='coalesce' (the default) for speculation to serve"
+                "slot engine, which that knob does not govern — the paged "
+                "continuous engine speculates under TPU_RAG_SPEC_PAGED=1 "
+                "(with TPU_RAG_KV_PAGED=1; docs/SPECULATIVE.md); "
+                "batching='coalesce' (the default) serves the one-shot "
+                "speculative path"
             )
         from rag_llm_k8s_tpu.engine.continuous import (
             ContinuousEngine,

@@ -67,6 +67,11 @@ REQUIRED_KEYS = (
     # tolerance green); a silently dropped leg must fail the gate instead
     # of reading as "chunk reuse unjudged"
     "chunk_reuse.prefill_skip_frac",
+    # ISSUE 13: speculative decoding in the continuous paged engine — the
+    # B=8 spec-on/spec-off tok/s ratio on the repeat-heavy RAG workload
+    # (acceptance > 1.5×); a silently dropped leg must fail the gate, not
+    # read as "paged speculation unjudged"
+    "continuous_spec.b8_speedup",
 )
 
 

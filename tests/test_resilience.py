@@ -896,3 +896,185 @@ class TestChunkSpliceChaos:
         for k in list(cont._prefix_blocks):
             cont._drop_registration(k)
         assert cont.kv_pool.blocks_in_use() == 0
+
+
+class TestSpecChaos:
+    """ISSUE 13 chaos contracts (rides `make chaos`, tp=1 and tp=2): a
+    decode-step fault landing MID-verify-window and a pool-exhaustion
+    preemption of a SPECULATING row must both recover to byte-identical
+    streams with zero leaked blocks — a verify window holds more in
+    flight per fetch (K+1 writes, junk lanes, per-row acceptance), so
+    every recovery path is re-proven with speculation live."""
+
+    SPEC_CFG = None  # set lazily: EngineConfig is imported at module top
+
+    @classmethod
+    def _spec_cfg(cls, **over):
+        import dataclasses
+
+        base = dataclasses.replace(
+            ENG_CFG, kv_paged=True, kv_block_size=16, spec_paged=True,
+            spec_paged_tokens=4,
+        )
+        return dataclasses.replace(base, **over) if over else base
+
+    def _run_with_mid_stream_fault(self, cfg, params, mesh=None):
+        """Submit a long repeat-heavy request, arm decode_step only after
+        >= 2 verify windows have run (the fault provably lands MID-verify,
+        tokens already emitted by verify steps on both sides of the
+        reset), and return (stream, engine, request_info)."""
+        from rag_llm_k8s_tpu.obs import flight
+
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=self._spec_cfg(),
+            dtypes=FP32, mesh=mesh,
+        )
+        sched = ContinuousScheduler(eng, retry_backoff_s=0.0)
+        info = {}
+        out = [None]
+        err = [None]
+
+        def submit():
+            try:
+                out[0] = sched.submit(
+                    [11] * 12, max_new_tokens=40, timeout=300, info=info
+                )
+            except BaseException as e:  # noqa: BLE001
+                err[0] = e
+
+        try:
+            th = threading.Thread(target=submit)
+            th.start()
+            deadline = time.monotonic() + 120
+            while (
+                eng.stats.spec_verify_steps < 2
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.005)
+            assert eng.stats.spec_verify_steps >= 2, (
+                "no verify window ever ran — the fault would not land "
+                "mid-verify; fixture is vacuous"
+            )
+            faults.arm("decode_step", times=1)
+            th.join(timeout=300)
+            assert err[0] is None, err[0]
+            assert faults.armed() == {}, "decode_step fault never fired"
+            assert eng.kv_pool.blocks_in_use() == 0, eng.kv_pool.stats()
+            # the delivered stream's flight anchor: complete.stream_fnv
+            # over exactly the bytes the caller received
+            completes = [
+                e for e in flight.recorder().snapshot(etype="complete")
+                if e.get("rid") == info.get("request_id")
+            ]
+            if completes:
+                assert completes[-1]["stream_fnv"] == flight.stream_hash(
+                    out[0]
+                )
+            return out[0]
+        finally:
+            sched.shutdown()
+
+    def test_decode_fault_mid_verify_window_byte_identical(self, tiny):
+        cfg, params, oracle = tiny
+        want = oracle.generate([[11] * 12], max_new_tokens=40)[0]
+        got = self._run_with_mid_stream_fault(cfg, params)
+        assert got == want
+
+    def test_pool_exhaustion_preempts_speculating_row(self, tiny):
+        """A pool sized for half the batch's decode growth: speculating
+        rows preempt mid-verify-stream, resubmit (prompt + emitted), and
+        every stream still matches the fault-free oracle — zero leaks."""
+        prompts = [[3, 17, 42, 3, 17, 42, 3, 17], [5, 5, 8], [11] * 12,
+                   [2, 9, 2, 9, 2, 9, 2]]
+        cfg, params, oracle = tiny
+        want = [oracle.generate([p], max_new_tokens=40)[0] for p in prompts]
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=self._spec_cfg(kv_pool_blocks=8), dtypes=FP32,
+        )
+        sched = ContinuousScheduler(eng)
+        try:
+            outs = [None] * len(prompts)
+            errs = [None] * len(prompts)
+
+            def run(i):
+                try:
+                    outs[i] = sched.submit(
+                        prompts[i], max_new_tokens=40, timeout=300
+                    )
+                except BaseException as e:  # noqa: BLE001
+                    errs[i] = e
+
+            threads = [
+                threading.Thread(target=run, args=(i,))
+                for i in range(len(prompts))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert errs == [None] * len(prompts), errs
+            assert outs == want
+            assert eng.stats.spec_verify_steps > 0, "nothing speculated"
+            assert eng.kv_pool.blocks_in_use() == 0
+        finally:
+            sched.shutdown()
+
+    @pytest.fixture(scope="class")
+    def tp2(self, tiny):
+        from rag_llm_k8s_tpu.core.config import MeshConfig
+        from rag_llm_k8s_tpu.core.mesh import make_mesh
+        from rag_llm_k8s_tpu.parallel.sharding import shard_llama_params
+
+        cfg, params, oracle = tiny
+        ctx = make_mesh(MeshConfig(dp=4, sp=1, tp=2))
+        return cfg, shard_llama_params(params, ctx), oracle, ctx
+
+    def test_tp2_decode_fault_mid_verify_window(self, tp2):
+        """The same mid-verify fault recovery over the head-sharded
+        arena: the tp split must not open a leak or divergence path."""
+        cfg, params, oracle, ctx = tp2
+        want = oracle.generate([[11] * 12], max_new_tokens=40)[0]
+        got = self._run_with_mid_stream_fault(cfg, params, mesh=ctx)
+        assert got == want
+
+    def test_tp2_pool_exhaustion_preempts_speculating_row(self, tp2):
+        cfg, params, oracle, ctx = tp2
+        prompts = [[3, 17, 42, 3, 17, 42, 3, 17], [11] * 12,
+                   [2, 9, 2, 9, 2, 9, 2]]
+        want = [oracle.generate([p], max_new_tokens=40)[0] for p in prompts]
+        # pool = MB (the construction minimum): three rows' decode growth
+        # (~4 blocks each at 40 new tokens) cannot coexist — preemption
+        # must fire while rows speculate
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=self._spec_cfg(kv_pool_blocks=8), dtypes=FP32,
+            mesh=ctx,
+        )
+        sched = ContinuousScheduler(eng)
+        try:
+            outs = [None] * len(prompts)
+            errs = [None] * len(prompts)
+
+            def run(i):
+                try:
+                    outs[i] = sched.submit(
+                        prompts[i], max_new_tokens=40, timeout=300
+                    )
+                except BaseException as e:  # noqa: BLE001
+                    errs[i] = e
+
+            threads = [
+                threading.Thread(target=run, args=(i,))
+                for i in range(len(prompts))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert errs == [None] * len(prompts), errs
+            assert outs == want
+            assert eng.stats.spec_verify_steps > 0, "nothing speculated"
+            assert eng.kv_pool.blocks_in_use() == 0
+        finally:
+            sched.shutdown()
