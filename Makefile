@@ -106,6 +106,20 @@ spec-smoke:
 flight-smoke:
 	env TPU_RAG_FAULTS=1 JAX_PLATFORMS=cpu python -m pytest tests/test_flight.py::TestFlightSmoke -q -p no:cacheprovider
 
+# Goodput-ledger smoke (ISSUE 14, docs/GOODPUT.md): with the ledger ON
+# (its default), N concurrent mixed-length requests through the paged
+# scheduler must satisfy the conservation invariant — per-window category
+# chip-time sums to each window's duration, and per-request attributed
+# chip-seconds sum to the scheduler's measured busy time within 5%,
+# including under preemption (rework attributed once, never double) —
+# with a non-vacuous category split (compute, useful decode AND bubble
+# all present), and GET /debug/goodput honors the 403-unless-armed
+# contract while flightview --goodput rebuilds the same report offline.
+# The full matrix (roofline arithmetic, spec stats, one-shot windows,
+# env round-trip) lives in the rest of tests/test_goodput.py under tier1.
+goodput-smoke:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_goodput.py::TestSmoke -q -p no:cacheprovider
+
 # Perf regression gate (scripts/bench_gate.py): compare a fresh bench JSON
 # against a committed baseline with per-metric tolerance bands, direction
 # aware (latency up = bad, tok/s down = bad). Defaults to comparing the
@@ -167,7 +181,7 @@ check: test tpu-test bench
 # (validates the baseline + gate plumbing without running the bench — the
 # TPU-judged comparison is `make bench` followed by
 # `make bench-gate BENCH_CURRENT=...`).
-ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke flight-smoke lint analyze
+ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke flight-smoke goodput-smoke lint analyze
 	python scripts/bench_gate.py --baseline $(BENCH_BASELINE) --dry-run
 
-.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke flight-smoke ci lint analyze check validate-8b validate-70b
+.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke flight-smoke goodput-smoke ci lint analyze check validate-8b validate-70b

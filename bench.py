@@ -1256,6 +1256,88 @@ def measure_flight_overhead() -> dict:
     }
 
 
+def measure_goodput_overhead() -> dict:
+    """Goodput-ledger overhead (ISSUE 14 acceptance): B=8 continuous
+    decode steps/s through the PUBLIC ``engine.step()`` path — the one
+    that records a ``goodput_window`` per sync window — ledger-on vs
+    ledger-off, with ``overhead_frac`` gated ≤ 2% by ``bench_gate``
+    (direction: lower). Same deliberately-worst-case shape as
+    ``flight_overhead``: the tiny config's fastest-possible device step
+    maximizes the ledger's relative share, so the bound holds a fortiori
+    for production models. The flight recorder stays ON in both runs (its
+    cost is gated separately) so the division isolates pure ledger cost.
+
+    Also reports the ``goodput.mfu_decode`` / bubble headlines read off
+    the ledger-on run's report — the capacity numbers the ROADMAP item-3
+    router will consume (absolute MFU is host-relative; the regression
+    gate judges direction, mfu higher / bubble lower).
+    """
+    import jax
+
+    from rag_llm_k8s_tpu.core.config import (
+        DTypePolicy,
+        EngineConfig,
+        GoodputConfig,
+        LlamaConfig,
+        SamplingConfig,
+    )
+    from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine
+    from rag_llm_k8s_tpu.models.llama import init_llama_params
+    from rag_llm_k8s_tpu.obs import goodput as obs_goodput
+
+    cfg = LlamaConfig.tiny(vocab_size=128)
+    params = init_llama_params(jax.random.PRNGKey(0), cfg, DTypePolicy.fp32())
+    B, SYNC, WINDOWS = 8, 8, 8
+
+    state = {}
+
+    def steps_per_s(enabled: bool) -> float:
+        eng = ContinuousEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=224),
+            engine_config=EngineConfig(
+                prompt_buckets=(32,), max_batch_size=B, max_seq_len=256,
+                decode_sync_steps=SYNC,
+                goodput=GoodputConfig(enabled=enabled),
+            ),
+            dtypes=DTypePolicy.fp32(),
+        )
+        eng.warmup(batch_sizes=(B,))
+        eng.admit_many([
+            (i + 1, [cfg.bos_token_id] + [3 + i] * 20, 224, None)
+            for i in range(B)
+        ])
+        eng.step()  # settle the pipeline
+        best = 1e9
+        for _ in range(3):
+            t0 = time.monotonic()
+            for _ in range(WINDOWS):
+                eng.step()
+            best = min(best, time.monotonic() - t0)
+        if enabled:
+            state["report"] = obs_goodput.render_report(eng.ledger.state())
+        del eng
+        return WINDOWS * SYNC / best
+
+    on = steps_per_s(True)
+    off = steps_per_s(False)
+    rep = state["report"]
+    return {
+        "goodput_overhead": {
+            "b8_steps_per_s_on": round(on, 1),
+            "b8_steps_per_s_off": round(off, 1),
+            # floor at 0: run-to-run noise must not report a negative
+            # "overhead" a later regression reads as a baseline gain
+            "overhead_frac": round(max(0.0, 1.0 - on / off), 4),
+        },
+        "goodput": {
+            "mfu_decode": rep["kinds"].get("decode", {}).get("mfu", 0.0),
+            "decode_useful_frac": rep["categories"]["decode_useful"]["frac"],
+            "bubble_frac": rep["categories"]["padding_bubble"]["frac"],
+        },
+    }
+
+
 def measure_ingest_scale() -> dict:
     """VERDICT r4 #6: corpus-scale ingest THROUGH the HTTP path, snapshot
     save/load timing at that size, and live-index /query probes.
@@ -2716,6 +2798,7 @@ def bench_legs(line: dict):
         ("kv_tiering", lambda: line.update(measure_kv_tiering())),
         ("chunk_reuse", lambda: line.update(measure_chunk_reuse())),
         ("flight_overhead", lambda: line.update(measure_flight_overhead())),
+        ("goodput_overhead", lambda: line.update(measure_goodput_overhead())),
         ("query_e2e", lambda: line.update(measure_query_e2e())),
         ("ingest_scale", lambda: line.update(measure_ingest_scale())),
     ]

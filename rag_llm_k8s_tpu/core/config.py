@@ -403,6 +403,43 @@ class KVTieringConfig:
 
 
 @dataclass(frozen=True)
+class GoodputConfig:
+    """Goodput ledger: per-window chip-time attribution, roofline/MFU
+    accounting, and cost-per-query (obs/goodput.py, docs/GOODPUT.md).
+
+    ON BY DEFAULT: the ledger is pure host-side dict math per device sync
+    window (no device work, no I/O), held to ≤ 2% of B=8 decode steps/s
+    by the ``goodput_overhead`` bench gate — the same contract as the
+    flight recorder it journals through.
+    """
+
+    # master switch for the step ledger (env TPU_RAG_GOODPUT)
+    enabled: bool = True
+    # chip rental price, USD per chip-hour — powers cost_usd in /generate
+    # timings, rag_cost_* metrics and the /debug/goodput cost-per-query
+    # percentiles; 0 keeps chip-time attribution on but omits dollar
+    # figures (env TPU_RAG_CHIP_HOUR_USD)
+    chip_hour_usd: float = 0.0
+    # roofline peaks for MFU / bandwidth-utilization estimates; 0 = the
+    # generic TPU-v4-class defaults in obs/goodput.py (275 bf16 TFLOP/s,
+    # 1200 GB/s). Pin to your chip's datasheet for honest absolute MFU —
+    # every RELATIVE read (category split, regression direction) holds
+    # either way (env TPU_RAG_GOODPUT_PEAK_TFLOPS / TPU_RAG_GOODPUT_HBM_GBS)
+    peak_tflops: float = 0.0
+    hbm_gbs: float = 0.0
+
+    def validate(self) -> None:
+        if self.chip_hour_usd < 0:
+            raise ValueError(
+                f"goodput: chip_hour_usd={self.chip_hour_usd}: expected >= 0"
+            )
+        if self.peak_tflops < 0 or self.hbm_gbs < 0:
+            raise ValueError(
+                "goodput: peak_tflops/hbm_gbs must be >= 0 (0 = default)"
+            )
+
+
+@dataclass(frozen=True)
 class EngineConfig:
     """Serving-engine shape limits (no reference equivalent — the reference
     re-runs full HF generate per request, single-threaded)."""
@@ -584,6 +621,9 @@ class EngineConfig:
     spec_paged_min_accept: float = 0.3
     # cross-request KV prefix cache (see PrefixCacheConfig)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
+
+    # goodput ledger (obs/goodput.py, docs/GOODPUT.md) — on by default
+    goodput: GoodputConfig = field(default_factory=GoodputConfig)
     # hotness-aware KV tiering over the cached chunks (see KVTieringConfig;
     # needs prefix_cache.enabled to have anything to tier)
     kv_tiering: KVTieringConfig = field(default_factory=KVTieringConfig)
@@ -1097,6 +1137,28 @@ class AppConfig:
             )
         tiering.validate()  # cross-field rules once, with the env applied
         engine = dataclasses.replace(engine, kv_tiering=tiering)
+        goodput = engine.goodput
+        if "TPU_RAG_GOODPUT" in env:
+            flag = env["TPU_RAG_GOODPUT"]
+            if flag not in ("0", "1"):
+                raise ValueError(
+                    f"TPU_RAG_GOODPUT={flag!r}: expected '0' or '1'"
+                )
+            goodput = dataclasses.replace(goodput, enabled=flag == "1")
+        if "TPU_RAG_CHIP_HOUR_USD" in env:
+            goodput = dataclasses.replace(
+                goodput, chip_hour_usd=float(env["TPU_RAG_CHIP_HOUR_USD"])
+            )
+        if "TPU_RAG_GOODPUT_PEAK_TFLOPS" in env:
+            goodput = dataclasses.replace(
+                goodput, peak_tflops=float(env["TPU_RAG_GOODPUT_PEAK_TFLOPS"])
+            )
+        if "TPU_RAG_GOODPUT_HBM_GBS" in env:
+            goodput = dataclasses.replace(
+                goodput, hbm_gbs=float(env["TPU_RAG_GOODPUT_HBM_GBS"])
+            )
+        goodput.validate()  # range rules once, with the env applied
+        engine = dataclasses.replace(engine, goodput=goodput)
         resilience = cfg.resilience
 
         def _res_int(var: str, field_name: str, minimum: int):

@@ -77,6 +77,7 @@ from rag_llm_k8s_tpu.models.llama import (
     mask_window,
 )
 from rag_llm_k8s_tpu.obs import flight
+from rag_llm_k8s_tpu.obs import goodput as obs_goodput
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
 from rag_llm_k8s_tpu.resilience import faults
 from rag_llm_k8s_tpu.resilience.deadline import Deadline, DeadlineExceeded
@@ -275,6 +276,21 @@ class ContinuousEngine:
                     f"spec_paged_min_accept={self.spec_min_accept}: an "
                     "acceptance-RATE floor must lie in [0, 1]"
                 )
+        # ---- goodput ledger (obs/goodput.py; ISSUE 14) ------------------
+        # every device sync window — admission prefills, decode windows,
+        # verify windows — is attributed into the closed category set with
+        # a per-request chip-second split; the scheduler pops each
+        # request's figures at delivery (/generate timings), /metrics
+        # reads the rolling totals, and each window journals ONE
+        # goodput_window flight event so flightview --goodput reconstructs
+        # the same report offline. Host-side dict math only; the
+        # goodput_overhead bench leg holds it to <= 2% of decode steps/s.
+        self.ledger = obs_goodput.ledger_for(config, engine_config)
+        # request ids whose NEXT admission re-feeds tokens already computed
+        # once (preemption / reset resubmission) — that admission's real
+        # token lanes are attributed preempt_rework, exactly once (the
+        # scheduler marks before requeueing; the admission pops)
+        self._rework_rids: "set" = set()
         self.params, fused = maybe_fuse_params(params, engine_config, mesh)
         self.params, quantized = maybe_quantize_params(self.params, engine_config)
         self.model = LlamaModel(
@@ -783,6 +799,7 @@ class ContinuousEngine:
                 request_id, suffix, prefix, C, max_new_c, row, row_key,
                 folded, toks,
             )
+        t_admit = time.perf_counter()
         row_cache, tok0s, row_starts = self._get("prefill_px", S, C)(
             self.params, self._put(toks), self._put(jnp.int32(len(suffix))),
             tuple(self._put(p) for p in prefix.planes),
@@ -807,6 +824,11 @@ class ContinuousEngine:
             "admit", request_id, slot=row, prompt_len=total,
             prefix_len=int(prefix.length), tok0=tok0,
         )
+        self._journal_window(self.ledger.record_prefill_px(
+            time.perf_counter() - t_admit, bucket=C, rid=request_id,
+            computed=len(suffix), skipped=int(prefix.length),
+            rework=bool(self._take_rework((request_id,))),
+        ))
         if tok0 in self.config.eos_token_ids or max_new_c <= 1:
             out = [] if tok0 in self.config.eos_token_ids else [tok0]
             self.stats.decode_tokens += len(out)
@@ -836,6 +858,7 @@ class ContinuousEngine:
         first sighting scatters the whole prefix and REGISTERS its full
         blocks (one cache ref each), so the next request with the same
         prompt head shares them without copying a byte."""
+        t_admit = time.perf_counter()
         bs = self.block_size
         plen = int(prefix.length)
         slen = len(suffix)
@@ -929,6 +952,11 @@ class ContinuousEngine:
             "admit", request_id, slot=row, prompt_len=total, prefix_len=plen,
             shared=shared_tok, tok0=tok0,
         )
+        self._journal_window(self.ledger.record_prefill_px(
+            time.perf_counter() - t_admit, bucket=C, rid=request_id,
+            computed=slen, skipped=plen,
+            rework=bool(self._take_rework((request_id,))),
+        ))
         if tok0 in self.config.eos_token_ids or max_new_c <= 1:
             out = [] if tok0 in self.config.eos_token_ids else [tok0]
             self.stats.decode_tokens += len(out)
@@ -1979,6 +2007,43 @@ class ContinuousEngine:
             return None
         return self._blocks_at_retire.pop(request_id, None)
 
+    # ------------------------------------------------------------------
+    # goodput ledger plumbing (obs/goodput.py; scheduler thread only)
+    # ------------------------------------------------------------------
+    def mark_rework(self, request_id: int) -> None:
+        """The next admission of ``request_id`` re-feeds tokens already
+        computed once (preemption resume / reset resubmission): its real
+        token lanes attribute to ``preempt_rework``, not fresh prefill.
+        The mark is consumed by exactly one admission — rework is never
+        double-counted."""
+        if len(self._rework_rids) > 4096:  # stale marks of failed retries
+            # sweep BEFORE adding: the fresh mark (and only accreted stale
+            # ones) must survive the overflow, or the very resubmission
+            # that tripped it loses its rework attribution
+            self._rework_rids.clear()
+        self._rework_rids.add(request_id)
+
+    def _take_rework(self, rids) -> "set":
+        taken = {r for r in rids if r in self._rework_rids}
+        self._rework_rids -= taken
+        return taken
+
+    def pop_request_goodput(self, request_id: int) -> Optional[Dict]:
+        """One completed request's attributed chip-time figures (chip_ms,
+        goodput_frac, cost_usd, speculation stats) — the scheduler
+        forwards them into the response timings at delivery."""
+        return self.ledger.pop_request(request_id)
+
+    def discard_request_goodput(self, request_id: int) -> None:
+        """Reclaim a never-delivered request's ledger entry (gave up /
+        deadline eviction / shutdown) — without this, failed requests
+        accrete until the bounded map evicts in-flight entries with them."""
+        self.ledger.discard_request(request_id)
+
+    def _journal_window(self, summary) -> None:
+        if summary is not None:
+            flight.emit("goodput_window", **summary)
+
     def blocks_needed(self, prompt_len: int) -> int:
         """Admission-time block cost of a prompt (0 in dense mode)."""
         if not self.paged:
@@ -2233,6 +2298,11 @@ class ContinuousEngine:
         slot, so it propagates out of the whole call."""
         free = self.free_slots()
         assert len(items) <= len(free), "admit_many() without enough free slots"
+        # the FIRST chunk's ledger window absorbs this call's prep (per-item
+        # key derivation is device work too) — without it, the per-request
+        # chip-second sums drift below the scheduler's measured busy time
+        # and the conservation invariant frays at small window counts
+        self._admit_lead = time.perf_counter()
 
         prepared = []  # (item_idx, rid, S, p, max_new_c, row_key)
         for i, (rid, prompt, max_new, seed) in enumerate(items):
@@ -2275,11 +2345,21 @@ class ContinuousEngine:
                         results[i] = e
         return results
 
+    def _admit_chunk_t0(self) -> float:
+        """This chunk's ledger-window start: the admit_many call's entry
+        stamp for the first chunk (prep absorbed), now for the rest."""
+        lead = getattr(self, "_admit_lead", None)
+        if lead is not None:
+            self._admit_lead = None
+            return lead
+        return time.perf_counter()
+
     def _admit_chunk(self, S: int, chunk, rows: List[int], results: List):
         """One batched prefill + insert + first-token fetch for ``chunk``."""
         if self.paged:
             return self._admit_chunk_paged(S, chunk, rows, results)
-        t_admit = time.perf_counter()
+        t_led = self._admit_chunk_t0()  # ledger window (prep absorbed)
+        t_admit = time.perf_counter()  # _m_step_admit keeps chunk-only
         n = len(chunk)
         tokens = np.full((n, S), self.pad_id, np.int32)
         mask = np.zeros((n, S), np.int32)
@@ -2351,6 +2431,11 @@ class ContinuousEngine:
                 m = np.ones(self.B, bool)
                 m[deactivate] = False
                 self._active = self._active & self._put(jnp.asarray(m))
+            led_rows = {rid: len(p) for _, rid, _, p, _, _ in chunk}
+            self._journal_window(self.ledger.record_prefill(
+                time.perf_counter() - t_led, bucket=S, rows=led_rows,
+                rework=self._take_rework(led_rows),
+            ))
         except BaseException:  # noqa: BLE001 — release before isolation
             # the insert already spliced these rows device-active; failing
             # here (e.g. the tok0 fetch) would otherwise leave them decoding
@@ -2371,7 +2456,8 @@ class ContinuousEngine:
         ``PoolExhausted`` during allocation is backpressure, not failure:
         already-taken blocks return and the exception propagates so the
         scheduler can requeue the chunk's items."""
-        t_admit = time.perf_counter()
+        t_led = self._admit_chunk_t0()  # ledger window (prep absorbed)
+        t_admit = time.perf_counter()  # _m_step_admit keeps chunk-only
         n = len(chunk)
         bs = self.block_size
         nb = S // bs
@@ -2388,6 +2474,14 @@ class ContinuousEngine:
         except PoolExhausted:
             for _, ids in taken:
                 self.kv_pool.free(ids)
+            # the bounced chunk cost real scheduler time (per-item key
+            # prep is device work): attribute the failed attempt to its
+            # requests — they requeue, and without this the conservation
+            # invariant frays under sustained pool pressure
+            self._journal_window(self.ledger.record_preempt_stall(
+                time.perf_counter() - t_led,
+                [c[1] for c in chunk], kind="prefill",
+            ))
             raise
         tokens = np.full((n, S), self.pad_id, np.int32)
         folded_keys, base_keys = [], []
@@ -2468,6 +2562,11 @@ class ContinuousEngine:
                 m = np.ones(self.B, bool)
                 m[deactivate] = False
                 self._active = self._active & self._put(jnp.asarray(m))
+            led_rows = {rid: len(p) for _, rid, _, p, _, _ in chunk}
+            self._journal_window(self.ledger.record_prefill(
+                time.perf_counter() - t_led, bucket=S, rows=led_rows,
+                rework=self._take_rework(led_rows),
+            ))
         except BaseException:  # noqa: BLE001 — release before isolation
             m = np.ones(self.B, bool)
             m[rows] = False
@@ -2498,17 +2597,28 @@ class ContinuousEngine:
             if any(drafts.values()) and self._verify_worthwhile(drafts):
                 return self._step_verify(drafts)
         k = self.sync_steps
+        t_w = time.perf_counter()  # ledger window: block growth included
         if self.paged:
             # map the blocks this window will write BEFORE dispatch (an
             # unmapped write vanishes into the null block and corrupts the
             # stream one step later); exhaustion preempts the newest rows
             self._ensure_decode_blocks()
             if not self.has_active():
-                return []  # everything was preempted: nothing to step
+                # everything was preempted: nothing to step — but the
+                # scheduler WAS busy preempting; attribute the stall to
+                # the preempted requests or conservation frays in storms
+                self._journal_window(self.ledger.record_preempt_stall(
+                    time.perf_counter() - t_w,
+                    [rid for rid, _ in self._preempted],
+                ))
+                return []
         flight.emit(
             "sync_window_open", steps=k,
             active=sum(1 for s in self.slots if s.active),
         )
+        # context tokens resident at dispatch (paged host mirror) — the
+        # decode window's KV-read bytes in the roofline estimate
+        ctx = sum(s.kv_ub for s in self.slots if s.active) if self.paged else 0
         t0 = time.perf_counter()
         if self.paged:
             (self._cache, self._kv_len, self._last_tok, toks, eoss,
@@ -2537,10 +2647,12 @@ class ContinuousEngine:
         eos_h = np.asarray(eoss)
         done: List[Tuple[int, List[int]]] = []
         deactivate = []
+        kept: Dict[int, int] = {}  # rid -> tokens this window kept (ledger)
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
             finished = False
+            kept[slot.request_id] = 0
             for j in range(k):
                 if eos_h[j, i]:
                     finished = True  # EOS token itself is not emitted
@@ -2550,6 +2662,7 @@ class ContinuousEngine:
                     slot.history.append(int(tok_h[j, i]))
                 slot.remaining -= 1
                 self.stats.decode_tokens += 1
+                kept[slot.request_id] += 1
                 if slot.remaining <= 0:
                     finished = True  # later window tokens (if any) discarded
                     break
@@ -2570,6 +2683,10 @@ class ContinuousEngine:
             self._active = self._active & self._put(jnp.asarray(mask))
             self._retire_rows(deactivate)  # paged: blocks back to the pool
         self._m_step_drain.observe(time.perf_counter() - t_fetch)
+        self._journal_window(self.ledger.record_decode(
+            time.perf_counter() - t_w, batch=self.B, steps=k,
+            kept=kept, ctx_tokens=ctx,
+        ))
         flight.emit(
             "sync_window_close", steps=k, done=len(done),
             duration_ms=round((time.perf_counter() - t0) * 1e3, 3),
@@ -2647,11 +2764,18 @@ class ContinuousEngine:
         resume are shared, so every recovery path sees one shape of
         state."""
         K = self.spec_K
+        t_w = time.perf_counter()  # ledger window: block growth included
         self._ensure_decode_blocks(
             {row: len(d) + 1 for row, d in drafts.items()}
         )
         if not self.has_active():
-            return []  # everything was preempted: nothing to verify
+            # everything was preempted: same stall attribution as the
+            # plain window's early return
+            self._journal_window(self.ledger.record_preempt_stall(
+                time.perf_counter() - t_w,
+                [rid for rid, _ in self._preempted],
+            ))
+            return []
         d_arr = np.zeros((self.B, K), np.int32)
         nd = np.zeros((self.B,), np.int32)
         for row, d in drafts.items():
@@ -2690,6 +2814,8 @@ class ContinuousEngine:
         deactivate = []
         drafted_total = int(nd.sum())
         accepted_total = 0
+        # ledger + per-request spec stats: rid -> (kept, offered, accepted)
+        led_rows: Dict[int, Tuple[int, int, int]] = {}
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
@@ -2700,6 +2826,7 @@ class ContinuousEngine:
             # advanced kv_len by exactly n_emit valid positions
             slot.kv_ub = min(slot.kv_ub + int(ne_h[i]), Tmax - 1)
             finished = False
+            n_kept = 0
             for j in range(int(ne_h[i])):
                 if eos_h[j, i]:
                     finished = True  # EOS token itself is not emitted
@@ -2708,9 +2835,11 @@ class ContinuousEngine:
                 slot.history.append(int(tok_h[j, i]))
                 slot.remaining -= 1
                 self.stats.decode_tokens += 1
+                n_kept += 1
                 if slot.remaining <= 0:
                     finished = True  # tokens past the budget discarded
                     break
+            led_rows[slot.request_id] = (n_kept, offered, m)
             if finished:
                 done.append((slot.request_id, slot.tokens))
                 flight.emit(
@@ -2735,6 +2864,11 @@ class ContinuousEngine:
             self._active = self._active & self._put(jnp.asarray(mask))
             self._retire_rows(deactivate)  # paged: blocks back to the pool
         self._m_step_drain.observe(time.perf_counter() - t_fetch)
+        self._journal_window(self.ledger.record_verify(
+            time.perf_counter() - t_w, batch=self.B, lanes_per_row=K + 1,
+            rows=led_rows,
+            ctx_tokens=sum(s.kv_ub for s in self.slots if s.active),
+        ))
         flight.emit(
             "sync_window_close", steps=1, done=len(done),
             duration_ms=round((time.perf_counter() - t0) * 1e3, 3),
@@ -2776,6 +2910,12 @@ class ContinuousScheduler:
         self.retry_backoff_s = max(0.0, retry_backoff_s)
         # set by the service: engine resets feed the readiness breaker
         self.breaker = None
+        # measured busy wall-clock: time the dispatcher spent INSIDE
+        # engine.step()/admit_many() — the goodput conservation anchor
+        # (per-request attributed chip-seconds must sum to this within
+        # tolerance; tests/test_goodput.py pins 5%). Written only by the
+        # dispatcher thread; reads are gauge-grade.
+        self._busy_s = 0.0
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
         self._stop = threading.Event()
         # serializes the stop-check+enqueue in submit() against shutdown()'s
@@ -2863,7 +3003,18 @@ class ContinuousScheduler:
             # paged mode: the row's peak block footprint (per-row
             # blocks_allocated in the /generate timings block)
             info["kv_blocks_allocated"] = item.blocks_allocated
+        if info is not None and item.goodput is not None:
+            # goodput ledger: this request's attributed chip-time figures
+            # (chip_ms / goodput_frac / cost_usd / speculation stats) —
+            # the service folds them into the /generate timings block
+            info["goodput"] = item.goodput
         return item.result
+
+    def busy_seconds(self) -> float:
+        """Wall-clock the dispatcher spent inside engine device work
+        (step + admissions) — the independent measurement the goodput
+        conservation invariant is checked against."""
+        return self._busy_s
 
     def run_on_engine(self, fn) -> bool:
         """Enqueue a host-side engine task — ``fn(engine)`` — executed by
@@ -2931,6 +3082,7 @@ class ContinuousScheduler:
                     if queued is not None and not callable(queued):
                         leftovers.append(queued)
             for it in leftovers:
+                self.engine.discard_request_goodput(it.request_id)
                 it.error = err
                 it.done.set()
 
@@ -3005,9 +3157,13 @@ class ContinuousScheduler:
                         continue  # dead on arrival: no prefill for it
                     batch.append(nxt)
                 try:
-                    admitted = eng.admit_many(
-                        [(b.request_id, b.prompt, b.max_new, b.seed) for b in batch]
-                    )
+                    t_busy = time.perf_counter()
+                    try:
+                        admitted = eng.admit_many(
+                            [(b.request_id, b.prompt, b.max_new, b.seed) for b in batch]
+                        )
+                    finally:
+                        self._busy_s += time.perf_counter() - t_busy
                     for b, res in zip(batch, admitted):
                         if isinstance(res, PoolExhausted):
                             # the chunk raced the pool (another chunk of
@@ -3071,6 +3227,7 @@ class ContinuousScheduler:
             it = waiting.pop(rid)
             if not it.abandoned:  # the caller already counted its expiry
                 self._m_deadline_decode.inc()
+            self.engine.discard_request_goodput(rid)  # never delivered
             it.error = DeadlineExceeded("decode", it.deadline.budget_ms)
             it.done.set()
 
@@ -3091,13 +3248,21 @@ class ContinuousScheduler:
         if item.retried:
             self._m_retries.labels(outcome="succeeded").inc()
         item.blocks_allocated = self.engine.pop_blocks_allocated(item.request_id)
+        item.goodput = self.engine.pop_request_goodput(item.request_id)
         item.result = item.emitted + tokens
         # stream_fnv anchors the timeline to the BYTES the client received:
         # a reconstructed lifecycle (admit → reset → resubmit → complete)
-        # is provably consistent with the delivered stream
+        # is provably consistent with the delivered stream. The goodput
+        # attribution rides along so an offline journal can compute
+        # cost-per-query percentiles with no live pod.
+        extra = {}
+        if item.goodput is not None:
+            extra["chip_ms"] = item.goodput["chip_ms"]
+            if "cost_usd" in item.goodput:
+                extra["cost_usd"] = round(item.goodput["cost_usd"], 8)
         flight.emit(
             "complete", item.request_id, n_tokens=len(item.result),
-            stream_fnv=flight.stream_hash(item.result),
+            stream_fnv=flight.stream_hash(item.result), **extra,
         )
         item.done.set()
 
@@ -3125,6 +3290,10 @@ class ContinuousScheduler:
                 continue
             self._fold_emitted(it, toks)
             it.resumed = True
+            # the resumed admission re-feeds prompt+emitted — tokens the
+            # chip already computed once: attribute that admission's lanes
+            # to preempt_rework (the ledger's goodput cost of preemption)
+            self.engine.mark_rework(rid)
             flight.emit(
                 "resubmit", rid, outcome="preempt_resume",
                 n_emitted=len(toks),
@@ -3150,6 +3319,7 @@ class ContinuousScheduler:
             else:
                 self._m_retries.labels(outcome="gave_up").inc()
                 flight.emit("resubmit", it.request_id, outcome="gave_up")
+                self.engine.discard_request_goodput(it.request_id)
                 it.error = cause
                 it.done.set()
         if not retry:
@@ -3167,6 +3337,9 @@ class ContinuousScheduler:
             self._fold_emitted(it, toks)
             it.retries_left -= 1
             it.retried = True
+            # reset recovery re-prefills the whole prompt (+ emitted):
+            # rework lanes, not fresh prefill, in the goodput ledger
+            self.engine.mark_rework(it.request_id)
             self._m_retries.labels(outcome="resubmitted").inc()
             flight.emit(
                 "resubmit", it.request_id, outcome="resubmitted",
@@ -3199,7 +3372,12 @@ class ContinuousScheduler:
         each) so a transient fault stays invisible to callers; requests out
         of retries (or past deadline) get the error instead of a hang."""
         try:
-            self._drain_done(self.engine.step(), waiting)
+            t_busy = time.perf_counter()
+            try:
+                done = self.engine.step()
+            finally:
+                self._busy_s += time.perf_counter() - t_busy
+            self._drain_done(done, waiting)
             self._resume_preempted(waiting)
         except BaseException as e:  # noqa: BLE001 — recover, don't die
             logger.exception(
@@ -3243,3 +3421,4 @@ class _Pending:
     abandoned: bool = False  # caller gave up (it counted the expiry)
     resumed: bool = False  # requeued after a paged pool preemption
     blocks_allocated: Optional[int] = None  # paged: peak block footprint
+    goodput: Optional[Dict] = None  # ledger attribution (chip_ms/cost/spec)

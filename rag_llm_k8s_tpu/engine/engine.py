@@ -50,6 +50,8 @@ from rag_llm_k8s_tpu.models.llama import (
     make_kv_cache,
     mask_window,
 )
+from rag_llm_k8s_tpu.obs import flight
+from rag_llm_k8s_tpu.obs import goodput as obs_goodput
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
 from rag_llm_k8s_tpu.resilience import faults
 from rag_llm_k8s_tpu.utils.buckets import bucket_len, next_pow2
@@ -224,6 +226,12 @@ class InferenceEngine:
         self._lock = threading.Lock()
         self._rng_counter = 0
         self.stats = EngineStats()
+        # goodput ledger (obs/goodput.py; ISSUE 14): the one-shot engine's
+        # generate is ONE device program, so the roofline model splits each
+        # call's measured duration into prefill/decode shares analytically
+        # ("oneshot" windows; the continuous engine measures its windows
+        # exactly). Journals a goodput_window flight event per call.
+        self.ledger = obs_goodput.ledger_for(config, engine_config)
         # observability handles (obs/metrics.py): standalone engines report
         # into the process default registry; RagService rebinds to its own
         self.bind_metrics(obs_metrics.default_registry())
@@ -278,6 +286,48 @@ class InferenceEngine:
     def _observe_generate(self, seconds: float, decode_steps: int) -> None:
         self._m_generate.observe(seconds)
         self._m_itl.observe(seconds / max(decode_steps, 1))
+
+    def _record_oneshot(
+        self, call_s: float, bucket: int, batch: int, computed: int,
+        decode_tokens: int, decode_steps: int, skipped: int = 0,
+        info: Optional[Dict] = None,
+    ) -> None:
+        """Fold one generate call into the goodput ledger, journal its
+        ``goodput_window`` event, and (when the caller passed an ``info``
+        out-param) surface the per-request share for the /generate
+        timings block."""
+        w = self.ledger.record_oneshot(
+            call_s, bucket=bucket, batch=batch, computed_tokens=computed,
+            decode_tokens=decode_tokens, decode_steps=decode_steps,
+            skipped=skipped,
+        )
+        if w is None:
+            return
+        per_row = w.pop("chip_ms_per_row")
+        frac = w.pop("goodput_frac")
+        flight.emit("goodput_window", **w)
+        if info is not None:
+            gp = {"chip_ms": per_row, "goodput_frac": frac}
+            if self.ledger.chip_hour_usd > 0:
+                gp["cost_usd"] = (
+                    per_row / 1e3 / 3600.0 * self.ledger.chip_hour_usd
+                )
+            prev = info.get("goodput")
+            if prev and prev.get("chip_ms"):
+                # a chunked generate() calls this once per sub-batch with
+                # ONE info dict: accumulate — overwriting would report
+                # only the last chunk's share and under-bill the caller
+                chip = prev["chip_ms"] + gp["chip_ms"]
+                gp["goodput_frac"] = round(
+                    (prev["chip_ms"] * prev.get("goodput_frac", 0.0)
+                     + gp["chip_ms"] * frac) / chip, 6,
+                )
+                gp["chip_ms"] = round(chip, 4)
+                if "cost_usd" in gp or "cost_usd" in prev:
+                    gp["cost_usd"] = (
+                        prev.get("cost_usd", 0.0) + gp.get("cost_usd", 0.0)
+                    )
+            info["goodput"] = gp
 
     # ------------------------------------------------------------------
     # compiled generate graph (one per (B, S, max_new))
@@ -674,6 +724,7 @@ class InferenceEngine:
         n_chunks: int,
         max_new_tokens: Optional[int] = None,
         seed: Optional[int] = None,
+        info: Optional[Dict] = None,  # out-param: per-request goodput share
     ) -> List[int]:
         """Single-fetch RAG generate (see ``_build_generate_rag``): the
         caller hands DEVICE arrays for the packed retrieve output and the
@@ -737,9 +788,11 @@ class InferenceEngine:
             if int(t) in eos:
                 break
             row.append(int(t))
+        spec_accept = None
         if spec and iters > 0:
             emitted = len(row) + (1 if len(row) < max_new else 0) - 1
             self._spec_record(max(emitted, 0), iters)
+            spec_accept = round(max(emitted, 0) / iters, 4)
         self._observe_generate(call_s, len(row))
         with self._lock:
             self.stats.generate_calls += 1
@@ -748,6 +801,20 @@ class InferenceEngine:
             # host-known share (the service adds the gathered chunk share
             # post-hoc once the ids fetch lands — record_prefill)
             self.stats.prefill_tokens += LA + int(b.shape[0])
+        # goodput ledger: the assembled prompt length is decided ON DEVICE
+        # (fetching it would put a round-trip back on the path this mode
+        # exists to remove), so the computed-token figure is the host-known
+        # head + tail plus an n-chunks × max-segment ESTIMATE of the
+        # gathered share, clamped to the bucket — category split and MFU
+        # for this kind are estimates by construction (docs/GOODPUT.md)
+        self._record_oneshot(
+            call_s, bucket=S, batch=1,
+            computed=min(LA + int(b.shape[0]) + n * Lc, S),
+            decode_tokens=len(row), decode_steps=max(len(row), 1),
+            info=info,
+        )
+        if info is not None and spec_accept is not None and self.ledger.enabled:
+            info.setdefault("goodput", {})["spec_accept_len_mean"] = spec_accept
         return row
 
     def _get_rag_compiled(
@@ -1096,6 +1163,7 @@ class InferenceEngine:
         prefix,  # CachedPrefix
         max_new_tokens: Optional[int] = None,
         seed: Optional[int] = None,
+        info: Optional[Dict] = None,  # out-param: per-request goodput share
     ) -> List[int]:
         """Generate with a device-resident cached prefix: prefill touches
         only ``suffix_ids`` (the un-cached prompt tail); the prefix KV is
@@ -1159,6 +1227,11 @@ class InferenceEngine:
             self.stats.prefill_tokens += len(suffix_ids)
             self.stats.prefill_tokens_skipped += int(prefix.reused_tokens)
             self.stats.decode_tokens += len(row)
+        self._record_oneshot(
+            call_s, bucket=S_suf, batch=1, computed=len(suffix_ids),
+            decode_tokens=len(row), decode_steps=max(len(row), 1),
+            skipped=int(prefix.reused_tokens), info=info,
+        )
         return row
 
     def warm_prefixed(
@@ -1279,6 +1352,7 @@ class InferenceEngine:
         prompts: Sequence[Sequence[int]],
         max_new_tokens: Optional[int] = None,
         seed: Optional[int] = None,
+        info: Optional[Dict] = None,  # out-param: per-request goodput share
     ) -> List[List[int]]:
         """Generate continuations for a batch of token-id prompts.
 
@@ -1304,17 +1378,21 @@ class InferenceEngine:
             for sub, i in enumerate(range(0, len(prompts), cap)):
                 out.extend(
                     self._generate_batch(
-                        prompts[i : i + cap], max_new, jax.random.fold_in(base, sub)
+                        prompts[i : i + cap], max_new,
+                        jax.random.fold_in(base, sub), info=info,
                     )
                 )
             return out
-        return self._generate_batch(prompts, max_new, self._next_rng(seed))
+        return self._generate_batch(
+            prompts, max_new, self._next_rng(seed), info=info
+        )
 
     def _generate_batch(
         self,
         prompts: Sequence[Sequence[int]],
         max_new: int,
         rng: jax.Array,
+        info: Optional[Dict] = None,
     ) -> List[List[int]]:
         """One device call for <= max_batch_size prompts with a decided rng."""
         maxlen = max(len(p) for p in prompts)
@@ -1379,6 +1457,7 @@ class InferenceEngine:
                 row.append(int(t))
             results.append(row)
             n_decode += len(row)
+        spec_accept = None
         if spec and int(iters) > 0:
             # tokens the VERIFY forwards emitted: answer tokens + the EOS
             # that ended it (if any) MINUS tok0 (sampled at prefill, not by
@@ -1386,11 +1465,25 @@ class InferenceEngine:
             # /metrics counters
             emitted = len(results[0]) + (1 if len(results[0]) < max_new else 0) - 1
             self._spec_record(max(emitted, 0), int(iters))
+            spec_accept = round(max(emitted, 0) / int(iters), 4)
         self._observe_generate(call_s, max((len(r) for r in results), default=1))
         with self._lock:
             self.stats.generate_calls += 1
             self.stats.prefill_tokens += int(pad_mask.sum())
             self.stats.decode_tokens += n_decode
+        self._record_oneshot(
+            call_s, bucket=S, batch=B, computed=int(pad_mask.sum()),
+            decode_tokens=n_decode,
+            decode_steps=max((len(r) for r in results), default=1),
+            info=info,
+        )
+        if info is not None and spec_accept is not None and self.ledger.enabled:
+            # one-shot speculation: the device-side matcher folds draft
+            # outcomes into emitted/iters — the per-call acceptance mean
+            # is the only per-request figure it can expose. Gated on the
+            # ledger like every other goodput key: TPU_RAG_GOODPUT=0
+            # means NO goodput block in info, not a partial one
+            info.setdefault("goodput", {})["spec_accept_len_mean"] = spec_accept
         return results
 
     def _place_inputs(self, tokens: np.ndarray, pad_mask: np.ndarray, rng: jax.Array):

@@ -32,6 +32,16 @@ The causal layer (ISSUE 11):
   lifecycle timelines (``/debug/timeline/<id>``), and trigger-driven
   incident bundles (``/debug/incidents``; rendered offline by
   ``scripts/flightview.py``).
+
+The efficiency layer (ISSUE 14):
+
+- ``obs.goodput`` — the goodput ledger: per-device-sync-window chip-time
+  attribution into a closed category set, an analytic FLOPs/bytes
+  roofline (per-executable MFU / bandwidth utilization), per-request
+  chip-second + cost figures in ``/generate`` timings, and the
+  ``GET /debug/goodput`` capacity report (``flightview --goodput``
+  renders the same report offline). Stdlib-only by contract — the
+  offline renderer loads it by file path with no jax present.
 """
 
 from rag_llm_k8s_tpu.obs.metrics import MetricsRegistry, default_registry  # noqa: F401

@@ -94,6 +94,12 @@ EVENTS: Dict[str, str] = {
     "spec_verify": "a multi-token verify step judged its window's drafts "
                    "(drafted, accepted, rejected, emitted token counts — "
                    "accepted/drafted is the window's acceptance rate)",
+    "goodput_window": "one device sync window's goodput attribution "
+                      "(obs/goodput.py): kind, dur_ms, active requests, "
+                      "per-category chip-ms (summing to dur_ms — the "
+                      "conservation invariant), tokens, per-window "
+                      "mfu/bw/bound — flightview --goodput rebuilds the "
+                      "/debug/goodput report from these offline",
     # -- KV block pool (engine/kv_pool.py) -------------------------------
     "pool_alloc": "physical KV blocks taken from the pool (blocks, free "
                   "remaining)",

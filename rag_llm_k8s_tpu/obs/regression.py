@@ -87,7 +87,13 @@ _RULES: Tuple[Tuple[re.Pattern, str], ...] = tuple(
         (r"prefill_skip_frac", "higher"),
         (r"logit_max_err", "lower"),
         (r"logit_tol", "ignore"),
+        # goodput ledger (ISSUE 14): useful-work shares and tokens/$ must
+        # not shrink (mfu_* matches the mfu rule above)
+        (r"tokens_per_usd|goodput_frac|useful_frac", "higher"),
         # -- lower is better ----------------------------------------------
+        # goodput ledger (ISSUE 14): padding-bubble share of busy chip
+        # time — growth means admission shapes/batch occupancy regressed
+        (r"bubble_frac", "lower"),
         # flight-recorder cost (ISSUE 11): fraction of decode steps/s the
         # journal costs with the recorder on — growth is a regression
         (r"overhead_frac", "lower"),

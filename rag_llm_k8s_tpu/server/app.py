@@ -41,6 +41,7 @@ from rag_llm_k8s_tpu.engine.engine import InferenceEngine
 from rag_llm_k8s_tpu.index.store import VectorStore
 from rag_llm_k8s_tpu.obs import devices as obs_devices
 from rag_llm_k8s_tpu.obs import flight as obs_flight
+from rag_llm_k8s_tpu.obs import goodput as obs_goodput
 from rag_llm_k8s_tpu.obs import logging as obs_logging
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
 from rag_llm_k8s_tpu.obs import slo as obs_slo
@@ -165,6 +166,9 @@ class RagService:
         # _pcache_tier_stats); must exist before any scrape can fire
         self._tier_stats_memo = None
         self._chunk_counters_memo = None
+        # same pattern for the ~20 rag_goodput_*/rag_cost_* callbacks: one
+        # merged ledger snapshot serves the whole scrape
+        self._goodput_memo = None
         # engine flight recorder + incident bundles (obs/flight.py): the
         # journal is process-wide (decision points across the substrate
         # write to it long before any service exists), so the service only
@@ -633,6 +637,74 @@ class RagService:
         )
         for t in obs_flight.TRIGGERS:
             self._m_incidents.labels(trigger=t)
+        # goodput ledger (obs/goodput.py, docs/GOODPUT.md): per-window
+        # chip-time attribution fractions, rolling MFU / bandwidth
+        # utilization per executable kind, and the NinjaLLM cost framing
+        # (tokens per dollar) — all callback-valued off one memoized
+        # merged-ledger snapshot per scrape, summed over the serving
+        # engines; families exist in every mode (zeros while the ledger
+        # is off) so dashboards stay uniform
+        gp_chip = reg.labeled_counter(
+            "rag_goodput_chip_seconds_total",
+            "chip-seconds attributed per goodput category — the six WINDOW "
+            "categories only, each a true monotone counter summing to busy "
+            "time (idle = wall − busy can shrink while both engines run "
+            "concurrently, so it lives in rag_goodput_busy_frac and the "
+            "/debug/goodput report, never in a counter)",
+        )
+        for c in obs_goodput.WINDOW_CATEGORIES:
+            gp_chip.labels_callback(
+                lambda c=c: self._goodput_stats().get(f"chip_s_{c}", 0.0),
+                category=c,
+            )
+        gp_frac = reg.labeled_gauge(
+            "rag_goodput_window_frac",
+            "fraction of BUSY chip time per attribution category (the six "
+            "window categories sum to 1 while anything has run)",
+        )
+        for c in obs_goodput.WINDOW_CATEGORIES:
+            gp_frac.labels_callback(
+                lambda c=c: self._goodput_stats().get(f"frac_{c}", 0.0),
+                category=c,
+            )
+        reg.gauge(
+            "rag_goodput_busy_frac",
+            "busy / wall chip time since the ledger started (1 - this is "
+            "the idle fraction the disaggregation router wants to shrink)",
+            fn=lambda: self._goodput_stats().get("busy_frac", 0.0),
+        )
+        gp_mfu = reg.labeled_gauge(
+            "rag_goodput_mfu",
+            "rolling model-FLOPs utilization per executable kind (useful "
+            "token lanes only — padding lanes execute but earn nothing; "
+            "peaks from TPU_RAG_GOODPUT_PEAK_TFLOPS or the generic default)",
+        )
+        gp_bw = reg.labeled_gauge(
+            "rag_goodput_bandwidth_util",
+            "rolling HBM-bandwidth utilization estimate per executable "
+            "kind (roofline bytes model over measured window time)",
+        )
+        for k in obs_goodput.KINDS:
+            gp_mfu.labels_callback(
+                lambda k=k: self._goodput_stats().get(f"mfu_{k}", 0.0),
+                kind=k,
+            )
+            gp_bw.labels_callback(
+                lambda k=k: self._goodput_stats().get(f"bw_{k}", 0.0),
+                kind=k,
+            )
+        reg.counter(
+            "rag_cost_usd_total",
+            "chip rental spend so far at TPU_RAG_CHIP_HOUR_USD over WALL "
+            "time (an idle chip still bills; 0 while no price is set)",
+            fn=lambda: self._goodput_stats().get("cost_usd_total", 0.0),
+        )
+        reg.gauge(
+            "rag_cost_tokens_per_usd",
+            "useful decode tokens per dollar of wall-clock chip rental "
+            "(the NinjaLLM tokens/s/$ gate's numerator; 0 while no price)",
+            fn=lambda: self._goodput_stats().get("tokens_per_usd", 0.0),
+        )
         # per-device HBM + prefix-cache residency (obs/devices.py): the
         # dashboard view of an eviction storm under HBM pressure
         obs_devices.register_device_gauges(reg, self._prefix_bytes_by_device)
@@ -737,6 +809,65 @@ class RagService:
                 for k, v in pcache.tier_stats().items():
                     out[k] = out.get(k, 0.0) + v
         return out
+
+    # -- goodput ledger (obs/goodput.py) ---------------------------------
+    def _goodput_price(self) -> float:
+        """The chip-hour price, read from the engine LEDGERS first (the
+        same source the per-request cost_usd figures use — a service
+        whose engines were constructed with a priced EngineConfig must
+        not serve aggregate cost metrics from a different knob), with
+        the service config as the engine-less fallback."""
+        prices = [
+            getattr(e, "ledger").chip_hour_usd
+            for e in self._engines().values()
+            if getattr(e, "ledger", None) is not None
+        ]
+        if prices and max(prices) > 0:
+            return max(prices)
+        gp = getattr(getattr(self.config, "engine", None), "goodput", None)
+        return float(getattr(gp, "chip_hour_usd", 0.0) or 0.0)
+
+    def _goodput_state(self) -> Dict:
+        """Merged ledger state over the serving engines (continuous +
+        one-shot — both attribute their own windows)."""
+        states = []
+        for e in self._engines().values():
+            led = getattr(e, "ledger", None)
+            if led is not None:
+                states.append(led.state())
+        return obs_goodput.merge_states(states)
+
+    def _goodput_stats(self) -> Dict[str, float]:
+        """Flat per-scrape snapshot behind the ~20 rag_goodput_*/rag_cost_*
+        callbacks — memoized for a beat like the tier-stats snapshot (one
+        merge serves the whole scrape; benign race on the memo)."""
+        now = time.monotonic()
+        cached = self._goodput_memo
+        if cached is not None and now - cached[0] < 0.25:
+            return cached[1]
+        report = obs_goodput.render_report(
+            self._goodput_state(), chip_hour_usd=self._goodput_price()
+        )
+        out: Dict[str, float] = {"busy_frac": report["busy_frac"]}
+        for c, v in report["categories"].items():
+            out[f"chip_s_{c}"] = v["chip_s"]
+            if c != "idle":
+                out[f"frac_{c}"] = v["frac"]
+        for k, v in report["kinds"].items():
+            out[f"mfu_{k}"] = v["mfu"]
+            out[f"bw_{k}"] = v["bw_util"]
+        out["cost_usd_total"] = report["cost"]["wall_usd"]
+        out["tokens_per_usd"] = report["cost"]["tokens_per_usd"]
+        self._goodput_memo = (now, out)
+        return out
+
+    def goodput_report(self) -> Dict:
+        """The live capacity picture ``GET /debug/goodput`` serves —
+        rendered by the SAME function ``scripts/flightview.py --goodput``
+        applies to a journal/bundle offline, so the two cannot drift."""
+        return obs_goodput.render_report(
+            self._goodput_state(), chip_hour_usd=self._goodput_price()
+        )
 
     # -- incident bundles (obs/flight.py) --------------------------------
     def _maybe_reset_storm(self) -> None:
@@ -1126,6 +1257,31 @@ class RagService:
         tr.add_span("embed_knn", t0 + tok_s, knn_s, parent=pidx)
 
     # -- query ----------------------------------------------------------
+    @staticmethod
+    def _fold_goodput(timings: Dict[str, float], gen_info: Dict) -> None:
+        """Surface a request's goodput attribution in its timings block:
+        chip_ms (the chip-seconds this request was attributed), its
+        goodput_frac (useful share of that time), cost_usd when a
+        chip-hour price is configured, and the per-request speculation
+        stats (spec_accept_len_mean and drafted/accepted counts — an
+        acceptance collapse is visible per response, not only in the
+        EngineStats aggregates)."""
+        gp = gen_info.get("goodput")
+        if not gp:
+            return
+        for key in ("chip_ms", "goodput_frac", "cost_usd", "spec_drafted",
+                    "spec_accepted", "spec_accept_len_mean"):
+            if key in gp:
+                timings[key] = float(gp[key])
+
+    @staticmethod
+    def _round_timings(timings: Dict[str, float]) -> Dict[str, float]:
+        """The response's rounded timings view. cost_usd keeps 8 decimals
+        — a per-query cost is micro-dollars and 2 decimals would zero it;
+        goodput_frac keeps 4 so small useful shares stay readable."""
+        digits = {"cost_usd": 8, "goodput_frac": 4, "spec_accept_len_mean": 4}
+        return {k: round(v, digits.get(k, 2)) for k, v in timings.items()}
+
     def _deadline_check(self, dl: Optional[Deadline], stage: str) -> None:
         """One stage-boundary deadline check: count + raise on expiry."""
         if dl is not None and dl.expired():
@@ -1489,7 +1645,9 @@ class RagService:
                     with self._inflight_lock:
                         self._inflight_generate -= 1
                     in_generate = False
-                    out_ids = self.engine.generate([prompt_ids])[0]
+                    out_ids = self.engine.generate(
+                        [prompt_ids], info=gen_info
+                    )[0]
             if in_generate:
                 with self._inflight_lock:
                     self._inflight_generate -= 1
@@ -1505,6 +1663,7 @@ class RagService:
                 timings["kv_blocks_allocated"] = float(
                     gen_info["kv_blocks_allocated"]
                 )
+            self._fold_goodput(timings, gen_info)
             timings["total_ms"] = (time.monotonic() - t_all) * 1e3
         finally:
             # error paths (and the no-results return) must release their
@@ -1521,7 +1680,7 @@ class RagService:
         resp = {
             "generated_text": extract_answer(completion),
             "context": context,
-            "timings": {k: round(v, 2) for k, v in timings.items()},
+            "timings": self._round_timings(timings),
         }
         if "request_id" in gen_info:
             # continuous serving: the scheduler id keying this request's
@@ -1607,9 +1766,12 @@ class RagService:
         # out of generate_ms so the stage split stays honest either way
         timings["prefix_resolve_ms"] = (time.monotonic() - t_r) * 1e3
         t0 = time.monotonic()
+        gen_info: Dict[str, float] = {}
         with tracing.span("generate"):
             try:
-                out_ids = self.engine.generate_prefixed(b_ids, cp)
+                out_ids = self.engine.generate_prefixed(
+                    b_ids, cp, info=gen_info
+                )
             except ValueError:
                 return None  # tail over the suffix ladder: cold path serves
         t_de = time.monotonic()
@@ -1628,6 +1790,7 @@ class RagService:
         timings["prefill_tokens_skipped_frac"] = cp.reused_tokens / max(
             cp.reused_tokens + cp.computed_tokens + len(b_ids), 1
         )
+        self._fold_goodput(timings, gen_info)
         timings["total_ms"] = (time.monotonic() - t_all) * 1e3
         self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
         self.metrics.inc("query_decode_tokens", len(out_ids))
@@ -1636,7 +1799,7 @@ class RagService:
         return {
             "generated_text": extract_answer(completion),
             "context": context,
-            "timings": {k: round(v, 2) for k, v in timings.items()},
+            "timings": self._round_timings(timings),
         }
 
     def _answer_fused(self, user_prompt: str, fused_r, timings, t_all,
@@ -1697,9 +1860,11 @@ class RagService:
         th = threading.Thread(target=_fetch_ids, daemon=True, name="ids-fetch")
         th.start()
         t0 = time.monotonic()
+        gen_info: Dict[str, float] = {}
         with tracing.span("generate"):
             out_ids = self.engine.generate_rag(
-                a_ids, b_ids, packed_dev, toks_dev, lens_dev, n_chunks=n_ctx
+                a_ids, b_ids, packed_dev, toks_dev, lens_dev, n_chunks=n_ctx,
+                info=gen_info,
             )
         t_de = time.monotonic()
         with tracing.span("detokenize"):
@@ -1735,6 +1900,7 @@ class RagService:
         )
         context = assemble_context(results, n_kept)
         self.engine.record_prefill(used)
+        self._fold_goodput(timings, gen_info)
         timings["total_ms"] = (time.monotonic() - t_all) * 1e3
         self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
         self.metrics.inc("query_decode_tokens", len(out_ids))
@@ -1743,7 +1909,7 @@ class RagService:
         return {
             "generated_text": extract_answer(completion),
             "context": context,
-            "timings": {k: round(v, 2) for k, v in timings.items()},
+            "timings": self._round_timings(timings),
         }
 
     def _prompt_segments(self, user_prompt: str, results):
@@ -2032,6 +2198,8 @@ class WsgiApp:
                 Rule("/debug/timeline/<int:rid>", endpoint="debug_timeline",
                      methods=["GET"]),
                 Rule("/debug/incidents", endpoint="debug_incidents",
+                     methods=["GET"]),
+                Rule("/debug/goodput", endpoint="debug_goodput",
                      methods=["GET"]),
             ]
         )
@@ -2356,6 +2524,23 @@ class WsgiApp:
                 return self._jsonify(bundle)
             return self._jsonify({"incidents": spool.list()})
         except Exception as e:  # noqa: BLE001
+            return self._jsonify({"error": str(e)}, 500)
+
+    def ep_debug_goodput(self, request):
+        """The goodput/cost capacity picture (obs/goodput.py,
+        docs/GOODPUT.md): per-category chip-time split, roofline
+        classification + rolling MFU per executable kind, and
+        cost-per-query percentiles — the report the future
+        prefill/decode disaggregation router consumes. Same
+        403-unless-armed contract as every ``/debug`` route;
+        ``scripts/flightview.py --goodput`` renders the same report
+        offline from a journal or incident bundle."""
+        if not self._debug_enabled():
+            return self._debug_forbidden()
+        try:
+            return self._jsonify(self.service.goodput_report())
+        except Exception as e:  # noqa: BLE001
+            logger.exception("goodput report failed")
             return self._jsonify({"error": str(e)}, 500)
 
     def ep_debug_faults(self, request):

@@ -72,6 +72,10 @@ REQUIRED_KEYS = (
     # (acceptance > 1.5×); a silently dropped leg must fail the gate, not
     # read as "paged speculation unjudged"
     "continuous_spec.b8_speedup",
+    # ISSUE 14: the goodput ledger's measured cost (ledger-on vs -off B=8
+    # continuous decode; acceptance ≤ 2%) — the ledger is ON by default,
+    # so its overhead may never go unjudged in a bench round
+    "goodput_overhead.overhead_frac",
 )
 
 

@@ -8,7 +8,13 @@ copied off a pod's spool directory) — or a bare journal dump — into:
   inter-event deltas, as ASCII or JSON;
 - **a scheduler-occupancy summary**: windows observed, active-row
   distribution, rows completed, resets/preemptions/sheds in the window
-  the journal covers.
+  the journal covers;
+- **the goodput report** (``--goodput``): per-category chip-time split,
+  rolling MFU/roofline per executable kind, and cost-per-query
+  percentiles, rebuilt from the journal's ``goodput_window``/``complete``
+  events by the SAME renderer ``GET /debug/goodput`` uses live
+  (rag_llm_k8s_tpu/obs/goodput.py, loaded by file path so no jax is
+  pulled in) — the two reports cannot drift apart.
 
 No live pod, no jax, no third-party deps — a bundle is self-contained by
 contract (docs/OBSERVABILITY.md "Engine flight recorder").
@@ -17,6 +23,7 @@ Usage:
     python scripts/flightview.py BUNDLE.json            # ASCII render
     python scripts/flightview.py BUNDLE.json --json     # structured form
     python scripts/flightview.py BUNDLE.json --request 7
+    python scripts/flightview.py BUNDLE.json --goodput [--chip-hour-usd X]
 
 Input shapes accepted: a full incident bundle (``{"journal": [...],
 "trigger": ..., ...}``), a journal-only dump (``{"journal": [...]}``), or
@@ -27,7 +34,9 @@ a plain JSON list of events. Events newer than this tool's known
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import json
+import os
 import sys
 from typing import Dict, List, Optional
 
@@ -162,6 +171,75 @@ def render_ascii(view: Dict, meta: Optional[Dict] = None) -> str:
     return "\n".join(lines)
 
 
+def _load_goodput_module():
+    """Load obs/goodput.py DIRECTLY by file path: importing the package
+    would execute ``rag_llm_k8s_tpu.obs.__init__`` (which pulls tracing →
+    jax), and flightview must run on a laptop holding nothing but the
+    bundle. goodput.py is stdlib-only by contract."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir,
+        "rag_llm_k8s_tpu", "obs", "goodput.py",
+    )
+    spec = importlib.util.spec_from_file_location("_flightview_goodput", path)
+    if spec is None or spec.loader is None:
+        raise SystemExit(f"flightview: cannot load goodput module at {path}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def build_goodput_report(events: List[Dict],
+                         chip_hour_usd: float = 0.0) -> Dict:
+    """The offline half of the same-report contract: rebuild the ledger
+    state from ``goodput_window``/``complete`` events and render with the
+    exact function ``GET /debug/goodput`` uses live."""
+    gp = _load_goodput_module()
+    return gp.render_report(
+        gp.state_from_events(events), chip_hour_usd=chip_hour_usd
+    )
+
+
+def render_goodput_ascii(report: Dict) -> str:
+    lines = [
+        "goodput report",
+        f"  wall={report['wall_s']:.3f}s  busy={report['busy_s']:.3f}s"
+        f"  idle={report['idle_s']:.3f}s  busy_frac={report['busy_frac']:.3f}",
+        "  chip-time attribution (frac of busy; idle of wall):",
+    ]
+    for cat, v in report["categories"].items():
+        lines.append(
+            f"    {cat:<16} {v['chip_s']:>10.4f}s  frac={v['frac']:.4f}"
+        )
+    lines.append("  executables (roofline):")
+    for kind, v in report["kinds"].items():
+        lines.append(
+            f"    {kind:<11} windows={v['windows']:<5} busy={v['busy_s']:.4f}s"
+            f"  tokens={v['tokens']:<7} mfu={v['mfu']:.5f}"
+            f"  bw={v['bw_util']:.5f}  bound={v['bound']}"
+        )
+    cost = report["cost"]
+    pq = cost["per_query_chip_ms"]
+    lines.append(
+        f"  cost: chip_hour_usd={cost['chip_hour_usd']}"
+        f"  wall_usd={cost['wall_usd']}"
+        f"  tokens_per_usd={cost['tokens_per_usd']}"
+    )
+    lines.append(
+        f"  per-query chip_ms: p50={pq['p50']}  p95={pq['p95']}  n={pq['n']}"
+    )
+    if "per_query_usd" in cost:
+        pu = cost["per_query_usd"]
+        lines.append(
+            f"  per-query usd:     p50={pu['p50']}  p95={pu['p95']}"
+        )
+    cons = report["conservation"]
+    lines.append(
+        f"  conservation: attributed={cons['attributed_s']:.4f}s"
+        f"  busy={cons['busy_s']:.4f}s  ratio={cons['ratio']:.4f}"
+    )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("bundle", help="incident bundle / journal dump (JSON)")
@@ -169,6 +247,13 @@ def main(argv=None) -> int:
                     help="emit the structured view instead of ASCII")
     ap.add_argument("--request", type=int, default=None,
                     help="render only this request id's lifecycle")
+    ap.add_argument("--goodput", action="store_true",
+                    help="render the goodput/cost report rebuilt from the "
+                         "journal's goodput_window events instead of the "
+                         "lifecycle view")
+    ap.add_argument("--chip-hour-usd", type=float, default=0.0,
+                    help="chip rental price for the --goodput cost figures "
+                         "(defaults to 0: attribution only, no dollars)")
     args = ap.parse_args(argv)
     try:
         with open(args.bundle) as f:
@@ -177,6 +262,15 @@ def main(argv=None) -> int:
         print(f"flightview: cannot read {args.bundle}: {e}", file=sys.stderr)
         return 2
     events = load_events(doc)
+    if args.goodput:
+        report = build_goodput_report(
+            events, chip_hour_usd=args.chip_hour_usd
+        )
+        if args.as_json:
+            print(json.dumps(report, indent=1))
+        else:
+            print(render_goodput_ascii(report))
+        return 0
     view = build_view(events, request_id=args.request)
     if args.as_json:
         print(json.dumps(view, indent=1))

@@ -1,0 +1,632 @@
+"""Goodput ledger (ISSUE 14): per-window chip-time attribution,
+MFU/roofline accounting, and cost-per-query.
+
+The contracts under test (obs/goodput.py, docs/GOODPUT.md):
+
+- **Conservation**: every ``goodput_window`` event's category chip-ms sum
+  to its duration, and N concurrent mixed-length requests' attributed
+  chip-seconds sum to the scheduler's own independently-measured busy
+  time within 5% — including under preemption and reset recovery, whose
+  re-fed prefill lanes attribute to ``preempt_rework`` exactly once.
+- **Same report, two sources**: ``GET /debug/goodput`` (live ledger) and
+  ``scripts/flightview.py --goodput`` (offline journal reconstruction)
+  render through ONE shared function and agree on every figure the
+  journal covers.
+- **Per-request surfacing**: ``/generate`` timings carry ``chip_ms`` /
+  ``goodput_frac`` / ``cost_usd`` and the per-request speculation stats
+  (``spec_accept_len_mean``, drafted/accepted counts) that previously
+  existed only as EngineStats aggregates.
+- **Gating**: ``/debug/goodput`` is 403-unless-armed like every
+  ``/debug`` route; the ledger off (TPU_RAG_GOODPUT=0) attributes
+  nothing and journals nothing.
+"""
+
+import dataclasses
+import json
+import sys
+import threading
+from pathlib import Path
+
+import jax
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from rag_llm_k8s_tpu.core.config import (
+    AppConfig,
+    DTypePolicy,
+    EncoderConfig,
+    EngineConfig,
+    FlightConfig,
+    GoodputConfig,
+    LlamaConfig,
+    SamplingConfig,
+)
+from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine, ContinuousScheduler
+from rag_llm_k8s_tpu.engine.encoder import EncoderRunner
+from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+from rag_llm_k8s_tpu.index.store import VectorStore
+from rag_llm_k8s_tpu.models.bge_m3 import init_encoder_params
+from rag_llm_k8s_tpu.models.llama import init_llama_params
+from rag_llm_k8s_tpu.obs import flight
+from rag_llm_k8s_tpu.obs import goodput
+from rag_llm_k8s_tpu.resilience import faults
+from rag_llm_k8s_tpu.server.app import RagService, create_app
+
+from scripts import flightview  # noqa: E402
+
+FP32 = DTypePolicy.fp32()
+GREEDY = SamplingConfig(do_sample=False, max_new_tokens=24)
+# sync=4: the conservation bound compares per-request sums against the
+# scheduler's wall-clock busy timer, which also covers the ledger's own
+# ~50µs of post-window bookkeeping per step call — real window shapes
+# amortize that; degenerate sub-ms windows would spend the whole 5%
+# tolerance on it
+PAGED = EngineConfig(
+    prompt_buckets=(16, 32), max_batch_size=4, max_seq_len=128,
+    kv_paged=True, kv_block_size=16, decode_sync_steps=4,
+)
+MIXED_PROMPTS = [
+    [3, 17, 42, 7, 99], [5, 5, 8], [11] * 12, [2, 9],
+    [4] * 20, [7, 8, 9, 10, 11, 12],
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    params = init_llama_params(jax.random.PRNGKey(0), cfg, FP32)
+    return cfg, params
+
+
+def _roofline():
+    return goodput.roofline_for_llama(
+        num_layers=2, hidden_size=64, num_heads=4, num_kv_heads=2,
+        head_dim=16, intermediate_size=128, vocab_size=256,
+    )
+
+
+# ---------------------------------------------------------------------------
+# roofline arithmetic
+# ---------------------------------------------------------------------------
+class TestRoofline:
+    def test_figures_and_ridge(self):
+        rf = _roofline()
+        assert rf.flops_per_token > 0 and rf.weight_bytes > 0
+        assert rf.kv_bytes_per_token > 0
+        assert rf.ridge == pytest.approx(rf.peak_flops / rf.peak_bytes)
+        # splice weight: a KV copy is cheaper than a forward, never free
+        assert 0.0 < rf.splice_weight <= 1.0
+
+    def test_classification_compute_vs_bandwidth(self):
+        rf = _roofline()
+        # prefill-shaped: many flops per streamed byte → compute-bound
+        assert rf.classify(rf.peak_flops, rf.peak_bytes / 100) == "compute"
+        # decode-shaped: whole weight stream for one token → bandwidth
+        assert rf.classify(rf.flops_per_token, rf.weight_bytes) == "bandwidth"
+
+    def test_int8_variants_change_bytes_not_flops(self):
+        base = _roofline()
+        w8 = goodput.roofline_for_llama(
+            2, 64, 4, 2, 16, 128, 256, weight_bytes_per_param=1.0
+        )
+        kv8 = goodput.roofline_for_llama(2, 64, 4, 2, 16, 128, 256,
+                                         kv_quant="int8")
+        assert w8.flops_per_token == base.flops_per_token
+        assert w8.weight_bytes == pytest.approx(base.weight_bytes / 2)
+        # int8 KV: half payload + fp32 scales — less than bf16, not half
+        assert kv8.kv_bytes_per_token < base.kv_bytes_per_token
+        assert kv8.kv_bytes_per_token > base.kv_bytes_per_token / 2
+
+    def test_peak_overrides(self):
+        rf = goodput.roofline_for_llama(
+            2, 64, 4, 2, 16, 128, 256, peak_tflops=100.0, hbm_gbs=500.0
+        )
+        assert rf.peak_flops == pytest.approx(100e12)
+        assert rf.peak_bytes == pytest.approx(500e9)
+
+
+# ---------------------------------------------------------------------------
+# ledger unit semantics
+# ---------------------------------------------------------------------------
+class TestLedgerUnits:
+    def test_decode_window_conserves_and_splits_equally(self):
+        led = goodput.GoodputLedger(_roofline())
+        w = led.record_decode(0.1, batch=4, steps=2, kept={1: 2, 2: 1})
+        cats = sum(w[c] for c in goodput.WINDOW_CATEGORIES if c in w)
+        assert cats == pytest.approx(w["dur_ms"], rel=1e-6)
+        # 3 useful lanes of 8 → useful frac 3/8 of the window
+        assert w["decode_useful"] == pytest.approx(100.0 * 3 / 8, rel=1e-6)
+        r1, r2 = led.pop_request(1), led.pop_request(2)
+        # equal chip share per active request (d / A)
+        assert r1["chip_ms"] == pytest.approx(50.0, rel=1e-6)
+        assert r2["chip_ms"] == pytest.approx(50.0, rel=1e-6)
+        # request 1 kept 2 of the window's 3 useful lanes
+        assert r1["goodput_frac"] > r2["goodput_frac"]
+        assert led.pop_request(1) is None  # popped once
+
+    def test_disabled_ledger_records_nothing(self):
+        led = goodput.GoodputLedger(_roofline(), enabled=False)
+        assert led.record_decode(0.1, 4, 2, {1: 2}) is None
+        assert led.pop_request(1) is None
+        assert led.state()["busy_s"] == 0.0
+
+    def test_prefill_rework_attributed_not_useful(self):
+        led = goodput.GoodputLedger(_roofline())
+        w = led.record_prefill(0.1, bucket=16, rows={1: 8, 2: 8},
+                               rework={2})
+        assert w["prefill_compute"] == pytest.approx(25.0, rel=1e-6)
+        assert w["preempt_rework"] == pytest.approx(25.0, rel=1e-6)
+        r1, r2 = led.pop_request(1), led.pop_request(2)
+        assert r1["chip_ms"] == pytest.approx(r2["chip_ms"])
+        assert r1["goodput_frac"] > 0.0
+        assert r2["goodput_frac"] == 0.0  # rework earns nothing
+
+    def test_prefill_px_skipped_weighting(self):
+        led = goodput.GoodputLedger(_roofline())
+        w = led.record_prefill_px(0.1, bucket=8, rid=1, computed=8,
+                                  skipped=64)
+        assert w["prefill_skipped"] > 0.0
+        # splice service is weighted DOWN: 64 skipped tokens must not
+        # out-bill the 8 computed ones by their raw count
+        assert w["prefill_skipped"] < w["prefill_compute"] * 64 / 8
+        cats = sum(w[c] for c in goodput.WINDOW_CATEGORIES if c in w)
+        assert cats == pytest.approx(w["dur_ms"], rel=1e-6)
+
+    def test_verify_window_spec_stats_reach_the_request(self):
+        led = goodput.GoodputLedger(_roofline())
+        led.record_verify(0.1, batch=2, lanes_per_row=5,
+                          rows={1: (4, 4, 3), 2: (1, 2, 0)})
+        led.record_verify(0.1, batch=2, lanes_per_row=5,
+                          rows={1: (2, 3, 1), 2: (1, 0, 0)})
+        r1 = led.pop_request(1)
+        assert r1["spec_drafted"] == 7 and r1["spec_accepted"] == 4
+        assert r1["spec_accept_len_mean"] == pytest.approx(2.0)
+        r2 = led.pop_request(2)
+        assert r2["spec_drafted"] == 2 and r2["spec_accepted"] == 0
+        # row 2 offered drafts in one window only
+        assert r2["spec_accept_len_mean"] == pytest.approx(0.0)
+
+    def test_cost_usd_appears_only_when_priced(self):
+        led = goodput.GoodputLedger(_roofline(), chip_hour_usd=3.6)
+        led.record_decode(1.0, batch=1, steps=1, kept={1: 1})
+        r = led.pop_request(1)
+        # 1 chip-second at $3.6/hr = $0.001
+        assert r["cost_usd"] == pytest.approx(0.001, rel=1e-6)
+        led2 = goodput.GoodputLedger(_roofline())
+        led2.record_decode(1.0, batch=1, steps=1, kept={1: 1})
+        assert "cost_usd" not in led2.pop_request(1)
+
+    def test_merge_and_render(self):
+        a, b = goodput.GoodputLedger(_roofline()), goodput.GoodputLedger(_roofline())
+        a.record_decode(0.2, 2, 1, {1: 1})
+        b.record_prefill(0.1, 16, {2: 8})
+        merged = goodput.merge_states([a.state(), b.state()])
+        assert merged["busy_s"] == pytest.approx(0.3, rel=1e-6)
+        report = goodput.render_report(merged, chip_hour_usd=1.0)
+        fracs = sum(
+            v["frac"] for c, v in report["categories"].items() if c != "idle"
+        )
+        assert fracs == pytest.approx(1.0, rel=1e-6)
+        assert report["conservation"]["ratio"] == pytest.approx(1.0, rel=1e-6)
+        assert set(report["kinds"]) == {"decode", "prefill"}
+        assert report["cost"]["chip_hour_usd"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# the smoke set (make goodput-smoke)
+# ---------------------------------------------------------------------------
+class TestSmoke:
+    def test_conservation_concurrent_mixed_lengths(self, tiny):
+        """THE acceptance invariant: N concurrent mixed-length requests
+        through the paged scheduler — per-request attributed chip-seconds
+        sum to the scheduler's independently measured busy time within
+        5%, every goodput_window's categories sum to its duration, and
+        the split is non-vacuous (compute, useful decode AND bubble all
+        present)."""
+        cfg, params = tiny
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=PAGED, dtypes=FP32
+        )
+        eng.warmup(batch_sizes=(4,))  # compiles out of the measured span
+        seq0 = flight.recorder().events_emitted
+        sched = ContinuousScheduler(eng)
+        try:
+            infos = [dict() for _ in MIXED_PROMPTS]
+            outs = [None] * len(MIXED_PROMPTS)
+
+            def run(i):
+                outs[i] = sched.submit(
+                    MIXED_PROMPTS[i], timeout=120, info=infos[i]
+                )
+
+            threads = [
+                threading.Thread(target=run, args=(i,))
+                for i in range(len(MIXED_PROMPTS))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert all(o is not None for o in outs)
+            # per-request figures surfaced through submit(info=)
+            total_chip_s = 0.0
+            for info in infos:
+                gp = info["goodput"]
+                assert gp["chip_ms"] > 0
+                assert 0.0 < gp["goodput_frac"] <= 1.0
+                total_chip_s += gp["chip_ms"] / 1e3
+            busy = sched.busy_seconds()
+            assert busy > 0
+            assert abs(total_chip_s - busy) / busy < 0.05, (
+                f"attributed {total_chip_s:.4f}s vs busy {busy:.4f}s"
+            )
+            # per-window conservation + non-vacuous split, from the journal
+            events = [
+                e for e in flight.recorder().snapshot(etype="goodput_window")
+                if e["seq"] >= seq0
+            ]
+            assert events, "no goodput_window events journaled"
+            seen = {c: 0.0 for c in goodput.WINDOW_CATEGORIES}
+            for e in events:
+                cats = sum(
+                    e.get(c, 0.0) for c in goodput.WINDOW_CATEGORIES
+                )
+                assert cats == pytest.approx(e["dur_ms"], abs=0.01)
+                for c in seen:
+                    seen[c] += e.get(c, 0.0)
+            assert seen["prefill_compute"] > 0
+            assert seen["decode_useful"] > 0
+            assert seen["padding_bubble"] > 0
+        finally:
+            sched.shutdown()
+
+    def test_preemption_rework_attributed_once(self, tiny):
+        """Chaos lane: a pool sized to force preemption — the resumed
+        request's re-fed admission attributes to preempt_rework, the
+        conservation invariant still holds, and rework is counted at
+        most once per re-feeding admission (bounded by re-fed tokens)."""
+        cfg, params = tiny
+        # 8 blocks of 16: two 12-token prompts decoding 24 tokens each
+        # must collide mid-decode and preempt (each row grows to 3 blocks)
+        tight = dataclasses.replace(PAGED, kv_pool_blocks=8)
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=tight, dtypes=FP32
+        )
+        eng.warmup(batch_sizes=(4,))
+        sched = ContinuousScheduler(eng)
+        try:
+            prompts = [[11] * 12, [7] * 12, [3] * 12, [9] * 12]
+            infos = [dict() for _ in prompts]
+            outs = [None] * len(prompts)
+
+            def run(i):
+                # a LONG decode (80 tokens → 6 blocks/row vs the 8-block
+                # pool) guarantees mid-decode collisions AND builds enough
+                # total busy time that host noise (GC pauses, container
+                # scheduling) amortizes under the 5% conservation bound
+                outs[i] = sched.submit(
+                    prompts[i], max_new_tokens=80, timeout=120,
+                    info=infos[i],
+                )
+
+            threads = [
+                threading.Thread(target=run, args=(i,))
+                for i in range(len(prompts))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert all(o is not None for o in outs)
+            st = eng.ledger.state()
+            if eng.stats is not None and eng.kv_pool is not None:
+                assert eng.kv_pool.blocks_in_use() == 0
+            # the tight pool preempted at least one row → rework attributed
+            preempts = flight.recorder().snapshot(etype="preempt")
+            if preempts:  # deterministic on this shape, but stay honest
+                assert st["categories"]["preempt_rework"] > 0
+            total_chip_s = sum(
+                i["goodput"]["chip_ms"] / 1e3 for i in infos
+            )
+            busy = sched.busy_seconds()
+            assert abs(total_chip_s - busy) / busy < 0.05
+            # never double-counted: rework cannot exceed the whole of
+            # admission-window time
+            kinds = st["kinds"]
+            adm_busy = sum(
+                kinds.get(k, {}).get("busy_s", 0.0)
+                for k in ("prefill", "prefill_px")
+            )
+            assert st["categories"]["preempt_rework"] <= adm_busy + 1e-9
+        finally:
+            sched.shutdown()
+
+    def test_reset_recovery_attributes_rework(self, tiny):
+        """An injected decode fault resets the engine; the resubmitted
+        request's re-prefill lands in preempt_rework and the request
+        still carries a coherent attribution."""
+        cfg, params = tiny
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=PAGED, dtypes=FP32
+        )
+        sched = ContinuousScheduler(eng, retry_backoff_s=0.0)
+        try:
+            faults.arm("decode_step", times=1)
+            info = {}
+            out = sched.submit([3, 17, 42], timeout=120, info=info)
+            assert out
+            gp = info["goodput"]
+            assert gp["chip_ms"] > 0
+            st = eng.ledger.state()
+            assert st["categories"]["preempt_rework"] > 0
+        finally:
+            sched.shutdown()
+
+    def test_ledger_off_attributes_nothing(self, tiny):
+        cfg, params = tiny
+        off = dataclasses.replace(PAGED, goodput=GoodputConfig(enabled=False))
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=off, dtypes=FP32
+        )
+        seq0 = flight.recorder().events_emitted
+        sched = ContinuousScheduler(eng)
+        try:
+            info = {}
+            out = sched.submit([3, 17, 42], timeout=120, info=info)
+            assert out
+            assert "goodput" not in info
+            assert eng.ledger.state()["busy_s"] == 0.0
+            assert not [
+                e for e in flight.recorder().snapshot(etype="goodput_window")
+                if e["seq"] >= seq0
+            ]
+        finally:
+            sched.shutdown()
+
+    def test_debug_goodput_contract(self, goodput_service, monkeypatch):
+        """403 unless armed; armed, the report carries the category
+        split, roofline kinds and cost block the router consumes."""
+        monkeypatch.delenv("TPU_RAG_FAULTS", raising=False)
+        monkeypatch.delenv("TPU_RAG_DEBUG", raising=False)
+        client = create_app(goodput_service).test_client()
+        r = client.get("/debug/goodput")
+        assert r.status_code == 403
+        assert "error" in r.get_json()
+        monkeypatch.setenv("TPU_RAG_FAULTS", "1")
+        client = create_app(goodput_service).test_client()
+        # serve one query so the report is non-empty — and whichever
+        # serving tail takes it (the DEFAULT fused single-fetch path
+        # included), its timings must carry the attribution
+        r = client.post("/generate", json={"prompt": "alpha"})
+        assert r.status_code == 200
+        t = r.get_json()["timings"]
+        assert t["chip_ms"] > 0 and 0.0 < t["goodput_frac"] <= 1.0
+        report = client.get("/debug/goodput").get_json()
+        assert report["schema_version"] == 1
+        assert set(report["categories"]) == set(goodput.CATEGORIES)
+        assert report["busy_s"] > 0
+        fracs = sum(
+            v["frac"] for c, v in report["categories"].items() if c != "idle"
+        )
+        assert fracs == pytest.approx(1.0, rel=1e-4)
+        assert report["kinds"]  # at least one executable attributed
+        for v in report["kinds"].values():
+            assert v["bound"] in ("compute", "bandwidth")
+        assert "per_query_chip_ms" in report["cost"]
+        assert report["conservation"]["ratio"] == pytest.approx(1.0, rel=1e-4)
+
+    def test_flightview_goodput_renders_same_report(self, tiny, tmp_path):
+        """The acceptance contract's second half: flightview --goodput
+        over a journal dump reproduces the live report's figures for the
+        windows the ring covers (one shared renderer)."""
+        cfg, params = tiny
+        flight.configure(capacity=8192)  # ring must cover the whole run
+        try:
+            eng = ContinuousEngine(
+                cfg, params, sampling=GREEDY, engine_config=PAGED,
+                dtypes=FP32,
+            )
+            sched = ContinuousScheduler(eng)
+            try:
+                for p in MIXED_PROMPTS[:3]:
+                    sched.submit(p, timeout=120)
+            finally:
+                sched.shutdown()
+            live = goodput.render_report(
+                eng.ledger.state(), chip_hour_usd=2.0
+            )
+            bundle = {
+                "schema_version": flight.SCHEMA_VERSION,
+                "journal": flight.recorder().snapshot(),
+            }
+            path = tmp_path / "journal.json"
+            path.write_text(json.dumps(bundle))
+            offline = flightview.build_goodput_report(
+                flightview.load_events(bundle), chip_hour_usd=2.0
+            )
+            # same schema, same figures (event chip-ms rounds at 0.1 µs)
+            assert set(offline) == set(live)
+            for c in goodput.WINDOW_CATEGORIES:
+                assert offline["categories"][c]["chip_s"] == pytest.approx(
+                    live["categories"][c]["chip_s"], abs=1e-4
+                )
+            for kind, lv in live["kinds"].items():
+                ov = offline["kinds"][kind]
+                assert ov["windows"] == lv["windows"]
+                assert ov["tokens"] == lv["tokens"]
+                assert ov["mfu"] == pytest.approx(lv["mfu"], rel=0.01)
+                assert ov["bound"] == lv["bound"]
+            assert offline["cost"]["per_query_chip_ms"]["n"] == 3
+            assert offline["cost"]["per_query_chip_ms"]["p50"] > 0
+            # the CLI renders both forms standalone
+            rc = flightview.main([str(path), "--goodput", "--json",
+                                  "--chip-hour-usd", "2.0"])
+            assert rc == 0
+            rc = flightview.main([str(path), "--goodput"])
+            assert rc == 0
+        finally:
+            flight.configure(capacity=4096)
+
+
+# ---------------------------------------------------------------------------
+# per-request speculation stats in /generate timings (satellite)
+# ---------------------------------------------------------------------------
+class TestSpecStats:
+    def test_spec_counts_surface_per_request(self, tiny):
+        cfg, params = tiny
+        spec = dataclasses.replace(
+            PAGED, spec_paged=True, spec_paged_tokens=4, decode_sync_steps=1,
+        )
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=spec, dtypes=FP32
+        )
+        sched = ContinuousScheduler(eng)
+        try:
+            info = {}
+            # repeat-heavy prompt: prompt-lookup fires (the RAG shape)
+            out = sched.submit(
+                [3, 17, 42, 3, 17, 42, 3, 17], timeout=120, info=info
+            )
+            assert out
+            gp = info["goodput"]
+            assert gp["spec_drafted"] > 0, "no draft ever offered"
+            assert gp["spec_accepted"] >= 0
+            assert gp["spec_accept_len_mean"] >= 0.0
+            # the aggregate stats and the per-request stats see the same
+            # engine: a lone request's drafts ARE the engine's drafts
+            assert gp["spec_drafted"] == eng.stats.spec_drafted_tokens
+            assert gp["spec_accepted"] == eng.stats.spec_accepted_tokens
+        finally:
+            sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# one-shot engine windows
+# ---------------------------------------------------------------------------
+class TestOneShot:
+    def test_generate_records_oneshot_window_and_info(self, tiny):
+        cfg, params = tiny
+        eng = InferenceEngine(
+            cfg, params, sampling=GREEDY,
+            engine_config=EngineConfig(
+                prompt_buckets=(16, 32), max_batch_size=2, max_seq_len=64,
+                goodput=GoodputConfig(chip_hour_usd=3.6),
+            ),
+            dtypes=FP32,
+        )
+        info = {}
+        out = eng.generate([[3, 17, 42, 7]], info=info)[0]
+        assert out
+        gp = info["goodput"]
+        assert gp["chip_ms"] > 0
+        assert 0.0 < gp["goodput_frac"] <= 1.0
+        assert gp["cost_usd"] > 0
+        st = eng.ledger.state()
+        assert st["kinds"]["oneshot"]["windows"] == 1
+        # the fused call split: both prefill and decode shares attributed
+        cats = st["categories"]
+        assert cats["prefill_compute"] > 0 and cats["decode_useful"] > 0
+
+
+# ---------------------------------------------------------------------------
+# config env round-trip
+# ---------------------------------------------------------------------------
+class TestConfig:
+    def test_env_round_trip(self):
+        cfg = AppConfig.from_env({
+            "TPU_RAG_GOODPUT": "0",
+            "TPU_RAG_CHIP_HOUR_USD": "4.2",
+            "TPU_RAG_GOODPUT_PEAK_TFLOPS": "197",
+            "TPU_RAG_GOODPUT_HBM_GBS": "819",
+        })
+        gp = cfg.engine.goodput
+        assert gp.enabled is False
+        assert gp.chip_hour_usd == pytest.approx(4.2)
+        assert gp.peak_tflops == pytest.approx(197.0)
+        assert gp.hbm_gbs == pytest.approx(819.0)
+
+    def test_defaults_on(self):
+        gp = AppConfig.from_env({}).engine.goodput
+        assert gp.enabled is True
+        assert gp.chip_hour_usd == 0.0
+
+    @pytest.mark.parametrize("env", [
+        {"TPU_RAG_GOODPUT": "yes"},
+        {"TPU_RAG_CHIP_HOUR_USD": "-1"},
+        {"TPU_RAG_GOODPUT_PEAK_TFLOPS": "-5"},
+    ])
+    def test_invalid_values_raise(self, env):
+        with pytest.raises(ValueError):
+            AppConfig.from_env(env)
+
+
+# ---------------------------------------------------------------------------
+# service fixture (the /debug/goodput contract test)
+# ---------------------------------------------------------------------------
+class ByteTokenizer:
+    def encode(self, text):
+        return [b + 3 for b in text.encode("utf-8")]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return bytes((i - 3) % 256 for i in ids if i >= 3).decode(
+            "utf-8", "replace"
+        )
+
+
+@pytest.fixture(scope="module")
+def goodput_service(tmp_path_factory):
+    llama_cfg = LlamaConfig.tiny(vocab_size=300)
+    enc_cfg = EncoderConfig.tiny(vocab_size=300)
+    cfg = AppConfig(
+        model=llama_cfg, encoder=enc_cfg,
+        flight=FlightConfig(
+            spool_dir=str(tmp_path_factory.mktemp("spool")), cooldown_s=0.0,
+        ),
+        system_message="Use the context.",
+    )
+    params = init_llama_params(jax.random.PRNGKey(0), llama_cfg, FP32)
+    engine = InferenceEngine(
+        llama_cfg, params,
+        sampling=SamplingConfig(do_sample=False, max_new_tokens=8),
+        engine_config=EngineConfig(
+            prompt_buckets=(128, 256), max_batch_size=2, max_seq_len=512,
+        ),
+        dtypes=FP32,
+    )
+    ceng = ContinuousEngine(
+        llama_cfg, params,
+        sampling=SamplingConfig(do_sample=False, max_new_tokens=8),
+        engine_config=EngineConfig(
+            prompt_buckets=(64, 256), max_batch_size=4, max_seq_len=320,
+        ),
+        dtypes=FP32,
+    )
+    sched = ContinuousScheduler(ceng, retry_backoff_s=0.0)
+    encoder = EncoderRunner(
+        enc_cfg, init_encoder_params(jax.random.PRNGKey(1), enc_cfg, FP32),
+        dtypes=FP32, length_buckets=(32, 64), max_batch=4,
+    )
+    store = VectorStore(dim=enc_cfg.hidden_size)
+    svc = RagService(
+        cfg, engine, ByteTokenizer(), encoder, ByteTokenizer(), store,
+        scheduler=sched,
+    )
+    svc.ready = True
+    texts = ["alpha beta gamma", "delta epsilon zeta"]
+    vecs = encoder.encode([ByteTokenizer().encode(t) for t in texts])
+    store.add(list(vecs), [
+        {"filename": "f", "chunk_id": i, "text": t}
+        for i, t in enumerate(texts)
+    ])
+    yield svc
+    svc.shutdown()

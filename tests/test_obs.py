@@ -333,9 +333,12 @@ class TestTracedGenerate:
                                            "trace": True})
         assert r.status_code == 200, r.get_json()
         body = r.get_json()
-        # trace is additive: the timings contract is untouched
+        # trace is additive: the timings contract is untouched (chip_ms /
+        # goodput_frac are the goodput ledger's per-request attribution —
+        # ISSUE 14; cost_usd joins them only when a chip-hour price is set)
         assert set(body["timings"]) == {
-            "tokenize_ms", "embed_retrieve_ms", "generate_ms", "total_ms"
+            "tokenize_ms", "embed_retrieve_ms", "generate_ms", "total_ms",
+            "chip_ms", "goodput_frac",
         }
         tree = body["trace"]
         names = [s["name"] for s in tree["spans"]]

@@ -132,7 +132,12 @@ class TestRoutes:
             assert "context" in body
             assert "Document 'doc" in body["context"]
             assert "score:" in body["context"]
-            assert set(body["timings"]) == {"tokenize_ms", "embed_retrieve_ms", "generate_ms", "total_ms"}
+            # chip_ms / goodput_frac: the goodput ledger's per-request
+            # attribution (ISSUE 14, additive; cost_usd only when priced)
+            assert set(body["timings"]) == {
+                "tokenize_ms", "embed_retrieve_ms", "generate_ms",
+                "total_ms", "chip_ms", "goodput_frac",
+            }
 
     def test_healthz_and_metrics(self, client):
         assert client.get("/healthz").status_code == 200
