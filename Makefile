@@ -120,6 +120,20 @@ flight-smoke:
 goodput-smoke:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_goodput.py::TestSmoke -q -p no:cacheprovider
 
+# Shadow-auditor smoke (ISSUE 15, docs/OBSERVABILITY.md "Shadow quality
+# auditor"): forced-sample shadow audits on the tiny config — greedy
+# spec-on continuous traffic and exact-chain prefix reuse audit at
+# divergence rate 0.0 (the byte-identity contracts hold on live
+# traffic); FORCED warm-tier demotion audits within the pinned 0.15
+# logit tolerance with the divergence attributed to warm_tier; and a
+# forced divergence burst spools a quality_divergence incident bundle
+# that scripts/flightview.py --quality round-trips offline into the
+# SAME report GET /debug/quality serves. The full matrix (sampling,
+# headroom/backlog skips, fingerprints, SLO spec, config round-trip)
+# lives in the rest of tests/test_shadow.py and runs under tier1.
+shadow-smoke:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_shadow.py::TestShadowSmoke -q -p no:cacheprovider
+
 # Perf regression gate (scripts/bench_gate.py): compare a fresh bench JSON
 # against a committed baseline with per-metric tolerance bands, direction
 # aware (latency up = bad, tok/s down = bad). Defaults to comparing the
@@ -181,7 +195,7 @@ check: test tpu-test bench
 # (validates the baseline + gate plumbing without running the bench — the
 # TPU-judged comparison is `make bench` followed by
 # `make bench-gate BENCH_CURRENT=...`).
-ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke flight-smoke goodput-smoke lint analyze
+ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke flight-smoke goodput-smoke shadow-smoke lint analyze
 	python scripts/bench_gate.py --baseline $(BENCH_BASELINE) --dry-run
 
-.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke flight-smoke goodput-smoke ci lint analyze check validate-8b validate-70b
+.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke flight-smoke goodput-smoke shadow-smoke ci lint analyze check validate-8b validate-70b

@@ -1338,6 +1338,113 @@ def measure_goodput_overhead() -> dict:
     }
 
 
+def measure_shadow_overhead() -> dict:
+    """Shadow-auditor overhead (ISSUE 15 acceptance): B=8 continuous
+    decode steps/s through the PUBLIC ``engine.step()`` path while a
+    shadow auditor concurrently re-runs completed requests on the
+    one-shot exact path, audits-on vs audits-off, with ``overhead_frac``
+    gated ≤ 2% by ``bench_gate`` (direction: lower).
+
+    The audit volume over-samples the ON-BY-DEFAULT deployment point:
+    the timed block is 24 windows (192 decode steps at B=8 ≈ 8 requests'
+    worth of 24-token answers) with ONE forced audit launched mid-block
+    and drained inside the timed region — 1/8 ≈ 2.5× the default 0.05
+    sample rate. The tiny config is the worst case for the DEVICE share
+    (the audited forward is the same size class as the serving steps it
+    competes with), and the headroom gate is bypassed so the audit
+    genuinely contends — production audits only run on idle beats and
+    sample at 0.05, so the measured bound holds a fortiori.
+    """
+    import jax
+
+    from rag_llm_k8s_tpu.core.config import (
+        DTypePolicy,
+        EngineConfig,
+        LlamaConfig,
+        SamplingConfig,
+        ShadowConfig,
+    )
+    from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine
+    from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+    from rag_llm_k8s_tpu.models.llama import init_llama_params
+    from rag_llm_k8s_tpu.obs.shadow import ShadowAuditor
+
+    cfg = LlamaConfig.tiny(vocab_size=128)
+    params = init_llama_params(jax.random.PRNGKey(0), cfg, DTypePolicy.fp32())
+    B, SYNC, WINDOWS = 8, 8, 24  # one timed block = 24 windows, 192 steps
+    prompt = [cfg.bos_token_id] + [5] * 20
+    oneshot = InferenceEngine(
+        cfg, params,
+        sampling=SamplingConfig(do_sample=False, max_new_tokens=24),
+        engine_config=EngineConfig(
+            prompt_buckets=(32,), max_batch_size=1, max_seq_len=256,
+        ),
+        dtypes=DTypePolicy.fp32(),
+    )
+    emitted = oneshot.generate([prompt])[0]
+    oneshot.score_exact(prompt, emitted)  # compile outside the timed loops
+    state = {"audits": 0}
+
+    def steps_per_s(audit: bool) -> float:
+        auditor = None
+        if audit:
+            auditor = ShadowAuditor(
+                ShadowConfig(sample_rate=1.0),
+                score_fn=oneshot.score_exact,
+            )
+        eng = ContinuousEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=720),
+            engine_config=EngineConfig(
+                prompt_buckets=(32,), max_batch_size=B, max_seq_len=768,
+                decode_sync_steps=SYNC,
+            ),
+            dtypes=DTypePolicy.fp32(),
+        )
+        eng.warmup(batch_sizes=(B,))
+        eng.admit_many([
+            (i + 1, [cfg.bos_token_id] + [3 + i] * 20, 720, None)
+            for i in range(B)
+        ])
+        eng.step()  # settle the pipeline
+        best = 1e9
+        for _ in range(3):
+            t0 = time.monotonic()
+            for w in range(WINDOWS):
+                eng.step()
+                if auditor is not None and w == 7:
+                    # ONE audit per 24-window block: 192 decode steps at
+                    # B=8 serve ~8 requests' worth of 24-token answers,
+                    # so 1/8 STILL over-samples the default 0.05 —
+                    # launched mid-block so it contends with real steps,
+                    # and the drain below keeps its tail inside the
+                    # timed region
+                    auditor.observe(emitted, prompt_ids=prompt, force=True)
+            if auditor is not None:
+                auditor.drain(timeout=30.0)
+            best = min(best, time.monotonic() - t0)
+        if auditor is not None:
+            state["audits"] = int(
+                sum(auditor.state()["audits"].values())
+            )
+            auditor.shutdown()
+        del eng
+        return WINDOWS * SYNC / best
+
+    on = steps_per_s(True)
+    off = steps_per_s(False)
+    return {
+        "shadow_overhead": {
+            "b8_steps_per_s_on": round(on, 1),
+            "b8_steps_per_s_off": round(off, 1),
+            "audits_run": state["audits"],
+            # floor at 0: run-to-run noise must not report a negative
+            # "overhead" a later regression reads as a baseline gain
+            "overhead_frac": round(max(0.0, 1.0 - on / off), 4),
+        }
+    }
+
+
 def measure_ingest_scale() -> dict:
     """VERDICT r4 #6: corpus-scale ingest THROUGH the HTTP path, snapshot
     save/load timing at that size, and live-index /query probes.
@@ -2799,6 +2906,7 @@ def bench_legs(line: dict):
         ("chunk_reuse", lambda: line.update(measure_chunk_reuse())),
         ("flight_overhead", lambda: line.update(measure_flight_overhead())),
         ("goodput_overhead", lambda: line.update(measure_goodput_overhead())),
+        ("shadow_overhead", lambda: line.update(measure_shadow_overhead())),
         ("query_e2e", lambda: line.update(measure_query_e2e())),
         ("ingest_scale", lambda: line.update(measure_ingest_scale())),
     ]

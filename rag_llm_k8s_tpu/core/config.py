@@ -768,6 +768,14 @@ class SloConfig:
     # (env TPU_RAG_SLO_TTFT_P95_OBJECTIVE / TPU_RAG_SLO_TTFT_P95_S)
     ttft_p95_objective: float = 0.95
     ttft_p95_s: float = 1.0
+    # answer-quality SLO over the shadow auditor's audited requests
+    # (obs/shadow.py): the objective fraction of audits whose measured
+    # exact-vs-delivered logit error stays under the pinned approximation
+    # tolerance — the same 0.15 the warm-tier and chunk-splice contracts
+    # pin in tests, now observed on live traffic
+    # (env TPU_RAG_SLO_QUALITY_OBJECTIVE / TPU_RAG_SLO_QUALITY_LOGIT_ERR)
+    quality_objective: float = 0.99
+    quality_logit_err: float = 0.15
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "SloConfig":
@@ -796,6 +804,12 @@ class SloConfig:
                 "TPU_RAG_SLO_TTFT_P95_OBJECTIVE", 0.95, 0.0, 1.0
             ),
             ttft_p95_s=_f("TPU_RAG_SLO_TTFT_P95_S", 1.0, 0.0, inf),
+            quality_objective=_f(
+                "TPU_RAG_SLO_QUALITY_OBJECTIVE", 0.99, 0.0, 1.0
+            ),
+            quality_logit_err=_f(
+                "TPU_RAG_SLO_QUALITY_LOGIT_ERR", 0.15, 0.0, inf
+            ),
         )
 
 
@@ -870,6 +884,81 @@ class FlightConfig:
         return out
 
 
+@dataclass(frozen=True)
+class ShadowConfig:
+    """Shadow-traffic quality auditor (obs/shadow.py).
+
+    Re-runs a sampled fraction of completed live requests on the EXACT
+    serving path (no prefix reuse, no speculation, the engine's native KV
+    dtype) and compares the shadow logits against the delivered stream —
+    the online measurement of every lossy-by-contract approximation in
+    the serving path (int8 warm tier, chunk splice/re-rotation, boundary
+    correction, speculative verify). ON BY DEFAULT: the audit is one
+    headroom-gated chunked forward per sampled request on the one-shot
+    engine (never the serving pool), and the ``shadow_overhead`` bench
+    leg pins its cost at ≤ 2% of B=8 decode steps/s.
+    """
+
+    # master switch (env TPU_RAG_SHADOW)
+    enabled: bool = True
+    # fraction of completed, audit-eligible requests re-run on the exact
+    # path (env TPU_RAG_SHADOW_SAMPLE_RATE; the on-by-default cost bound
+    # is stated at <= 0.05)
+    sample_rate: float = 0.05
+    # bounded audit queue: a sampled request arriving while this many
+    # audits are already pending is SKIPPED (counted, never queued
+    # unboundedly — audits must not pile up behind a busy device)
+    # (env TPU_RAG_SHADOW_BACKLOG)
+    backlog: int = 8
+    # divergence-burst incident window: the SECOND diverged audit inside
+    # this window spools a quality_divergence incident bundle (the same
+    # second-event to a bundle discipline as the reset storm)
+    # (env TPU_RAG_SHADOW_BURST_WINDOW_S)
+    burst_window_s: float = 300.0
+
+    def validate(self) -> None:
+        if not 0.0 <= self.sample_rate <= 1.0:
+            raise ValueError(
+                f"ShadowConfig.sample_rate={self.sample_rate}: a sampling "
+                "fraction must lie in [0, 1]"
+            )
+        if self.backlog < 1:
+            raise ValueError(
+                f"ShadowConfig.backlog={self.backlog}: expected >= 1"
+            )
+        if self.burst_window_s <= 0:
+            raise ValueError(
+                f"ShadowConfig.burst_window_s={self.burst_window_s}: "
+                "expected > 0"
+            )
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "ShadowConfig":
+        env = dict(os.environ if env is None else env)
+        out = cls()
+        if "TPU_RAG_SHADOW" in env:
+            flag = env["TPU_RAG_SHADOW"]
+            if flag not in ("0", "1"):
+                raise ValueError(
+                    f"TPU_RAG_SHADOW={flag!r}: expected '0' or '1'"
+                )
+            out = dataclasses.replace(out, enabled=flag == "1")
+        if "TPU_RAG_SHADOW_SAMPLE_RATE" in env:
+            out = dataclasses.replace(
+                out, sample_rate=float(env["TPU_RAG_SHADOW_SAMPLE_RATE"])
+            )
+        if "TPU_RAG_SHADOW_BACKLOG" in env:
+            out = dataclasses.replace(
+                out, backlog=int(env["TPU_RAG_SHADOW_BACKLOG"])
+            )
+        if "TPU_RAG_SHADOW_BURST_WINDOW_S" in env:
+            out = dataclasses.replace(
+                out, burst_window_s=float(env["TPU_RAG_SHADOW_BURST_WINDOW_S"])
+            )
+        out.validate()
+        return out
+
+
 # ---------------------------------------------------------------------------
 # top-level
 # ---------------------------------------------------------------------------
@@ -898,6 +987,7 @@ class AppConfig:
     lookahead: LookaheadConfig = field(default_factory=LookaheadConfig)
     slo: SloConfig = field(default_factory=SloConfig)
     flight: FlightConfig = field(default_factory=FlightConfig)
+    shadow: ShadowConfig = field(default_factory=ShadowConfig)
     system_message: str = SYSTEM_MESSAGE
 
     @classmethod
@@ -1223,4 +1313,5 @@ class AppConfig:
             resilience=resilience, lookahead=lookahead,
             slo=SloConfig.from_env(env),
             flight=FlightConfig.from_env(env),
+            shadow=ShadowConfig.from_env(env),
         )

@@ -257,6 +257,13 @@ class ContinuousEngine:
         # step would have sampled — greedy AND seeded streams stay
         # byte-identical by construction. docs/SPECULATIVE.md.
         self.spec_on = bool(getattr(engine_config, "spec_paged", False))
+        # requests whose rows ever OFFERED drafts to a verify window — the
+        # per-request approximation fingerprint's spec_verify source
+        # (obs/shadow.py). Engine state, NOT the goodput ledger: turning
+        # attribution accounting off must not erase audit fingerprints.
+        # Popped at delivery / discard; bounded against never-delivered
+        # rids by the discard sweep sharing the ledger's cleanup sites.
+        self._spec_rids: set = set()
         if self.spec_on:
             if not self.paged:
                 raise ValueError(
@@ -2034,11 +2041,24 @@ class ContinuousEngine:
         forwards them into the response timings at delivery."""
         return self.ledger.pop_request(request_id)
 
+    def pop_spec_seen(self, request_id: int) -> bool:
+        """True iff any verify window ever judged drafts for this request
+        — the spec_verify half of the per-request approximation
+        fingerprint (obs/shadow.py), independent of the goodput ledger.
+        Popping keeps the set bounded by in-flight requests."""
+        try:
+            self._spec_rids.remove(request_id)
+            return True
+        except KeyError:
+            return False
+
     def discard_request_goodput(self, request_id: int) -> None:
         """Reclaim a never-delivered request's ledger entry (gave up /
         deadline eviction / shutdown) — without this, failed requests
-        accrete until the bounded map evicts in-flight entries with them."""
+        accrete until the bounded map evicts in-flight entries with them.
+        The spec-fingerprint set shares the cleanup (same lifetime)."""
         self.ledger.discard_request(request_id)
+        self._spec_rids.discard(request_id)
 
     def _journal_window(self, summary) -> None:
         if summary is not None:
@@ -2821,6 +2841,8 @@ class ContinuousEngine:
                 continue
             offered, m = int(nd[i]), int(acc_h[i])
             accepted_total += m
+            if offered:
+                self._spec_rids.add(slot.request_id)
             slot.spec_ema = fold_acceptance(slot.spec_ema, offered, m)
             # the exact new frontier (not an upper bound): the device
             # advanced kv_len by exactly n_emit valid positions
@@ -3008,6 +3030,15 @@ class ContinuousScheduler:
             # (chip_ms / goodput_frac / cost_usd / speculation stats) —
             # the service folds them into the /generate timings block
             info["goodput"] = item.goodput
+        if info is not None and item.spec_seen:
+            # approximation fingerprint (obs/shadow.py): verify windows
+            # judged drafts for this request — stamped from ENGINE state
+            # (pop_spec_seen), never the goodput ledger, so
+            # TPU_RAG_GOODPUT=0 cannot erase speculation attribution
+            # from shadow audits
+            ap = info.setdefault("approx", [])
+            if "spec_verify" not in ap:
+                ap.append("spec_verify")
         return item.result
 
     def busy_seconds(self) -> float:
@@ -3249,6 +3280,8 @@ class ContinuousScheduler:
             self._m_retries.labels(outcome="succeeded").inc()
         item.blocks_allocated = self.engine.pop_blocks_allocated(item.request_id)
         item.goodput = self.engine.pop_request_goodput(item.request_id)
+        pop_spec = getattr(self.engine, "pop_spec_seen", None)
+        item.spec_seen = bool(pop_spec(item.request_id)) if pop_spec else False
         item.result = item.emitted + tokens
         # stream_fnv anchors the timeline to the BYTES the client received:
         # a reconstructed lifecycle (admit → reset → resubmit → complete)
@@ -3422,3 +3455,4 @@ class _Pending:
     resumed: bool = False  # requeued after a paged pool preemption
     blocks_allocated: Optional[int] = None  # paged: peak block footprint
     goodput: Optional[Dict] = None  # ledger attribution (chip_ms/cost/spec)
+    spec_seen: bool = False  # verify windows judged drafts for this request

@@ -815,6 +815,11 @@ class InferenceEngine:
         )
         if info is not None and spec_accept is not None and self.ledger.enabled:
             info.setdefault("goodput", {})["spec_accept_len_mean"] = spec_accept
+        if info is not None and spec and iters > 0:
+            # approximation fingerprint (obs/shadow.py): see generate()
+            ap = info.setdefault("approx", [])
+            if "spec_verify" not in ap:
+                ap.append("spec_verify")
         return row
 
     def _get_rag_compiled(
@@ -1067,6 +1072,146 @@ class InferenceEngine:
                 jax.ShapeDtypeStruct((), jnp.int32, sharding=ds),
                 self._prefix_plane_avals(P),
                 jax.ShapeDtypeStruct((), jnp.int32, sharding=ds),
+            )
+            .compile()
+        )
+
+    # ------------------------------------------------------------------
+    # exact-path shadow scoring (obs/shadow.py drives this)
+    # ------------------------------------------------------------------
+    # chunk width for the teacher-forced scorer: bounds the materialized
+    # [1, C, V] logit plane (the scorer needs EVERY position's logits,
+    # unlike serving prefill) — 256 × a 128k vocab is ~130 MB fp32
+    _SCORE_CHUNK = 256
+
+    def score_exact(self, prompt_ids: Sequence[int],
+                    emitted_ids: Sequence[int]) -> Dict[str, object]:
+        """Teacher-forced EXACT-PATH scoring for the shadow quality
+        auditor: ONE chunked forward over ``prompt + emitted`` with no
+        prefix reuse, no speculation, and the engine's native KV dtype —
+        the reference every serving-path approximation is judged against.
+
+        Returns per-emitted-position arrays (length ``len(emitted_ids)``):
+        ``argmax`` — the exact path's greedy choice given the DELIVERED
+        prefix, ``max_logit`` / ``chosen_logit`` — the exact logit of that
+        choice and of the delivered token (their gap is the divergence
+        evidence obs/shadow.py folds into ``logit_err``). Raises
+        ValueError on shapes past the chunked-prefill cap (the auditor
+        skips those as "oversize").
+
+        Argmax equivalence between this one forward and the step-by-step
+        decode loop is the property the speculative verify paths already
+        pin (their multi-token forwards must emit the vanilla loop's
+        tokens byte-identically), so a greedy byte-identity contract
+        audits clean here by construction.
+        """
+        x = [int(t) for t in prompt_ids] + [int(t) for t in emitted_ids]
+        W = len(emitted_ids)
+        if W == 0 or len(x) < 2:
+            raise ValueError("score_exact needs a prompt and >= 1 emitted token")
+        cap = self.engine_config.max_chunked_prompt
+        if len(x) > cap:
+            raise ValueError(
+                f"score_exact sequence of {len(x)} tokens exceeds "
+                f"max_chunked_prompt={cap}"
+            )
+        chunk = min(self._SCORE_CHUNK, max(self.engine_config.prompt_buckets))
+        S = -(-len(x) // chunk) * chunk
+        off = S - len(x)
+        tokens = np.full((1, S), self.pad_id, np.int32)
+        tokens[0, off:] = x
+        mask = np.zeros((1, S), np.int32)
+        mask[0, off:] = 1
+        nxt = np.zeros((1, S), np.int32)
+        nxt[0, : S - 1] = tokens[0, 1:]
+        fn = self._get_score_exact(S, chunk)
+        tokens_j, mask_j = jnp.asarray(tokens), jnp.asarray(mask)
+        nxt_j = jnp.asarray(nxt)
+        if self.mesh is not None:
+            rep = self.mesh.replicated
+            tokens_j, mask_j, nxt_j = (
+                jax.device_put(v, rep) for v in (tokens_j, mask_j, nxt_j)
+            )
+        stats = np.asarray(fn(self.params, tokens_j, mask_j, nxt_j))
+        lo = off + len(x) - W - 1  # slot whose logits predict emitted[0]
+        sl = slice(lo, lo + W)
+        return {
+            "argmax": stats[sl, 0].astype(np.int64),
+            "max_logit": stats[sl, 1].astype(np.float64),
+            "chosen_logit": stats[sl, 2].astype(np.float64),
+        }
+
+    def _get_score_exact(self, S: int, chunk: int):
+        key = (1, S, 0, ("shadow", chunk))
+        with self._lock:
+            fn = self._compiled.get(key)
+        if fn is None:
+            t0 = time.perf_counter()
+            fn = self._build_score_exact(S, chunk)
+            self._record_compile(time.perf_counter() - t0)
+            with self._lock:
+                self._compiled.setdefault(key, fn)
+                fn = self._compiled[key]
+        return fn
+
+    def _build_score_exact(self, S: int, chunk: int):
+        """AOT-compile the teacher-forced scorer: left-padded chunked
+        prefill over the full sequence, reducing each chunk's [1, C, V]
+        logit plane on device to per-position (argmax, max logit, logit of
+        the next delivered token) — the host fetches one [S, 3] array,
+        never a logit plane."""
+        cfg, dt = self.config, self.dtypes
+        mc = self.model_chunked
+        T = -(-S // 128) * 128
+        kvq = self.engine_config.kv_quant
+        i32 = jnp.int32
+
+        def score(params, tokens, pad_mask, next_tokens):
+            cache = make_kv_cache(cfg, 1, T, dt.compute_dtype, quant=kvq)
+            kv_start, _ = mask_window(pad_mask)
+            positions = jnp.clip(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
+            n_chunks = S // chunk
+
+            def body(carry, ci):
+                cache, stats = carry
+                wi = (ci * chunk).astype(i32)
+                tok_c = jax.lax.dynamic_slice(tokens, (0, wi), (1, chunk))
+                pos_c = jax.lax.dynamic_slice(positions, (0, wi), (1, chunk))
+                nxt_c = jax.lax.dynamic_slice(next_tokens, (0, wi), (1, chunk))
+                logits, cache = mc.apply(
+                    {"params": params}, tok_c, pos_c, cache,
+                    kv_start, jnp.broadcast_to(wi + chunk, (1,)).astype(i32),
+                    wi,
+                )
+                row = logits[0].astype(jnp.float32)  # [chunk, V]
+                amax = jnp.argmax(row, axis=-1)
+                mx = jnp.max(row, axis=-1)
+                chosen = jnp.take_along_axis(
+                    row, nxt_c[0][:, None], axis=-1
+                )[:, 0]
+                stats = jax.lax.dynamic_update_slice(
+                    stats,
+                    jnp.stack(
+                        [amax.astype(jnp.float32), mx, chosen], axis=-1
+                    ),
+                    (wi, jnp.int32(0)),
+                )
+                return (cache, stats), None
+
+            init = (cache, jnp.zeros((S, 3), jnp.float32))
+            (_, stats), _ = jax.lax.scan(
+                body, init, jnp.arange(n_chunks, dtype=i32)
+            )
+            return stats
+
+        ds = self.mesh.replicated if self.mesh is not None else None
+        return (
+            jax.jit(score, out_shardings=ds)
+            .lower(
+                param_avals(self.params),
+                jax.ShapeDtypeStruct((1, S), jnp.int32, sharding=ds),
+                jax.ShapeDtypeStruct((1, S), jnp.int32, sharding=ds),
+                jax.ShapeDtypeStruct((1, S), jnp.int32, sharding=ds),
             )
             .compile()
         )
@@ -1484,6 +1629,13 @@ class InferenceEngine:
             # ledger like every other goodput key: TPU_RAG_GOODPUT=0
             # means NO goodput block in info, not a partial one
             info.setdefault("goodput", {})["spec_accept_len_mean"] = spec_accept
+        if info is not None and spec and int(iters) > 0:
+            # approximation fingerprint (obs/shadow.py): speculation ran
+            # for this request — byte-identical by contract, and exactly
+            # what the shadow auditor exists to verify on live traffic
+            ap = info.setdefault("approx", [])
+            if "spec_verify" not in ap:
+                ap.append("spec_verify")
         return results
 
     def _place_inputs(self, tokens: np.ndarray, pad_mask: np.ndarray, rng: jax.Array):

@@ -90,6 +90,14 @@ class CachedPrefix:
     # reads these to splice registered pool blocks at arbitrary order
     # (ContinuousEngine._chunk_splice_plan). None under exact/slot reuse.
     chunks: Optional[Tuple] = None
+    # approximation fingerprint (obs/shadow.py APPROXIMATIONS): which
+    # lossy-by-contract mechanisms served THIS resolve — prefix_reuse
+    # (any cache hit), warm_tier (an int8-round-tripped entry spliced),
+    # splice / rerotate / boundary_fixup (chunk-granular shifted
+    # placements). Empty when every segment was built fresh. Memo
+    # re-serves carry the fingerprint recorded when the buffer was built
+    # (the content IS that content).
+    approx: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -205,6 +213,10 @@ class PrefixCache:
         # _assembled) so a memo hit still carries the per-chunk layout the
         # paged engine's block-table assembly consumes
         self._assembled_spans: Dict[tuple, Tuple] = {}
+        # approximation fingerprints per assembled buffer (keys ⊆
+        # _assembled): a memo re-serve is the SAME content the buffer was
+        # built with, so the shadow auditor attributes it identically
+        self._assembled_approx: Dict[tuple, Tuple[str, ...]] = {}
         # anchored at construction: the first opportunistic sweep waits a
         # full interval (a cache with nothing demotable yet should not pay
         # a sweep on its very first resolve)
@@ -399,6 +411,12 @@ class PrefixCache:
                         else None
                     ),
                     chunks=self._assembled_spans.get(akey),
+                    # the memo re-serves the content AS BUILT — same
+                    # fingerprint (plus prefix_reuse: the whole chain
+                    # served from cache, whatever built it originally)
+                    approx=tuple(sorted(set(
+                        self._assembled_approx.get(akey, ())
+                    ) | {"prefix_reuse"})),
                 )
             else:
                 hit = None
@@ -423,6 +441,7 @@ class PrefixCache:
         spans: List[ChunkSpan] = []
         outcomes: Dict[str, int] = {}
         fixup_tokens = 0
+        approx: set = set()  # this resolve's approximation fingerprint
         for key, ids in segments:
             seg_len = len(ids)
             ek = self._entry_key(key, off, chain)
@@ -510,14 +529,18 @@ class PrefixCache:
                 # the fallback leaks zero entries/blocks by construction
                 try:
                     faults.maybe_fail("chunk_splice")
+                    seg_marks = {"splice"}  # fingerprint iff this succeeds
                     if quantized and len(planes) == 4:
                         planes = dequantize_planes(planes, buf[0].dtype)
                         quantized = False
+                        seg_marks.add("warm_tier")
                     if delta:
                         planes = self.engine.rerotate_segment_kv(
                             planes, delta
                         )
                         flight.emit("rerotate", tokens=seg_len, delta=delta)
+                        seg_marks.add("rerotate")
+                    approx |= seg_marks
                 except Exception:  # noqa: BLE001 — KeyboardInterrupt propagates
                     logger.warning(
                         "chunk splice failed for %r; recomputing", ek,
@@ -562,6 +585,9 @@ class PrefixCache:
                 # tuple itself is immutable). The int8 round trip is the
                 # warm tier's bounded drift.
                 planes = dequantize_planes(planes, buf[0].dtype)
+                approx.add("warm_tier")
+            if not was_miss:
+                approx.add("prefix_reuse")  # served (at least partly) cached
             buf = self.engine.splice_prefix(buf, planes, off)
             if shifted:
                 # bounded boundary correction: re-prefill the chunk's first
@@ -576,6 +602,7 @@ class PrefixCache:
                         buf, self.engine.slice_prefix_block(fix, W), off
                     )
                     flight.emit("boundary_fixup", tokens=W)
+                    approx.add("boundary_fixup")
                     fixup_tokens += W
                     computed += W
                     reused += seg_len - W
@@ -614,6 +641,9 @@ class PrefixCache:
             if prev is not None:
                 self.assembled_bytes -= _planes_nbytes(prev[0])
             self._assembled[akey] = (buf, off)
+            # a memo re-serve is THIS content: record the fingerprint so
+            # the shadow auditor attributes repeats identically
+            self._assembled_approx[akey] = tuple(sorted(approx))
             if chunk_mode:
                 self._assembled_spans[akey] = tuple(spans)
             self._assembled_uses[akey] = 0
@@ -664,6 +694,7 @@ class PrefixCache:
                 akey if self.config.reuse in ("exact", "chunk") else None
             ),
             chunks=tuple(spans) if chunk_mode else None,
+            approx=tuple(sorted(approx)),
         )
 
     # -- lookahead staging (rag/lookahead.py drives these) ---------------
@@ -1022,6 +1053,7 @@ class PrefixCache:
         self._assembled_uses.pop(key, None)
         self._assembled_stamp.pop(key, None)
         self._assembled_spans.pop(key, None)
+        self._assembled_approx.pop(key, None)
         self.assembled_bytes -= _planes_nbytes(item[0])
         return True
 
@@ -1073,6 +1105,7 @@ class PrefixCache:
             self._assembled_uses.clear()
             self._assembled_stamp.clear()
             self._assembled_spans.clear()
+            self._assembled_approx.clear()
             self.entry_bytes = 0
             self.assembled_bytes = 0
             if self.spill is not None:

@@ -42,6 +42,18 @@ The efficiency layer (ISSUE 14):
   ``GET /debug/goodput`` capacity report (``flightview --goodput``
   renders the same report offline). Stdlib-only by contract — the
   offline renderer loads it by file path with no jax present.
+
+The quality layer (ISSUE 15):
+
+- ``obs.shadow`` — the shadow-traffic quality auditor: a sampled
+  fraction of completed requests re-runs on the EXACT serving path
+  (``InferenceEngine.score_exact``) and every divergence from the
+  delivered stream is measured and attributed to the approximation that
+  served it (warm tier / chunk splice / re-rotation / boundary fixup /
+  speculation); ``rag_quality_*`` metrics, the ``quality_p99_logit_err``
+  SLO's SLI, ``quality_divergence`` incident bundles, and the
+  ``GET /debug/quality`` report (``flightview --quality`` renders the
+  same report offline; stdlib-only by the same contract as goodput).
 """
 
 from rag_llm_k8s_tpu.obs.metrics import MetricsRegistry, default_registry  # noqa: F401

@@ -137,6 +137,19 @@ EVENTS: Dict[str, str] = {
                        "superseded | expired | abandoned | stale | failed)",
     "prestage": "a resolved retrieval's chunk KV pre-staged ahead of "
                 "admission (prefix-cache entries / pool registration)",
+    # -- shadow quality auditor (obs/shadow.py) --------------------------
+    "shadow_audit": "one sampled request's shadow audit finished (outcome: "
+                    "clean | diverged | skipped | failed; n tokens "
+                    "compared, err — the minimal explaining logit "
+                    "perturbation, pos — first divergence, approx — the "
+                    "request's approximation fingerprint, reason on "
+                    "skips). flightview --quality rebuilds the "
+                    "/debug/quality report from these offline",
+    "quality_divergence": "a shadow audit caught the delivered stream "
+                          "diverging from the exact path (pos, err, "
+                          "approx — the approximations the divergence is "
+                          "attributed to); a second one inside the burst "
+                          "window spools an incident bundle",
     # -- resilience (resilience/) ----------------------------------------
     "shed": "request rejected at the admission gate (reason, status)",
     "deadline": "a request's end-to-end deadline expired (stage)",
@@ -326,6 +339,7 @@ def config_fingerprint(config) -> Dict:
 #: incident triggers the spooler accepts (closed, like the event catalog)
 TRIGGERS = (
     "breaker_open", "reset_storm", "pool_exhausted_shed", "deadline_exceeded",
+    "quality_divergence",
 )
 
 

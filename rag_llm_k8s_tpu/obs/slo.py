@@ -104,7 +104,15 @@ def default_specs(cfg: Optional[SloConfig] = None) -> List[SloSpec]:
     - request p95 < 2 s (the BASELINE.md north-star budget applied at p95 —
       ``TPU_RAG_SLO_REQUEST_P95_S`` / ``_OBJECTIVE`` to retune);
     - TTFT p95 < 1 s (meaningful under continuous serving, where TTFT is
-      measured exactly; vacuously compliant when the histogram is empty).
+      measured exactly; vacuously compliant when the histogram is empty);
+    - quality p99 logit err ≤ 0.15: of the shadow auditor's audited
+      requests (obs/shadow.py — every audit observes its measured
+      exact-vs-delivered logit error into ``rag_quality_logit_err``, 0.0
+      when the streams matched), 99% must stay under the pinned
+      approximation tolerance. The SLI is dimensionless (a logit gap, not
+      seconds) but the windowed-burn machinery is identical — the
+      ``threshold_s`` field carries the logit bound. Vacuously compliant
+      while the auditor is off or nothing was audited.
     """
     if cfg is None:
         cfg = SloConfig.from_env()
@@ -117,6 +125,9 @@ def default_specs(cfg: Optional[SloConfig] = None) -> List[SloSpec]:
         SloSpec("ttft_p95", "latency", "rag_time_to_first_token_seconds",
                 objective=cfg.ttft_p95_objective,
                 threshold_s=cfg.ttft_p95_s),
+        SloSpec("quality_p99_logit_err", "latency", "rag_quality_logit_err",
+                objective=cfg.quality_objective,
+                threshold_s=cfg.quality_logit_err),
     ]
 
 

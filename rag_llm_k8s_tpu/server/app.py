@@ -44,6 +44,7 @@ from rag_llm_k8s_tpu.obs import flight as obs_flight
 from rag_llm_k8s_tpu.obs import goodput as obs_goodput
 from rag_llm_k8s_tpu.obs import logging as obs_logging
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
+from rag_llm_k8s_tpu.obs import shadow as obs_shadow
 from rag_llm_k8s_tpu.obs import slo as obs_slo
 from rag_llm_k8s_tpu.obs import tracing
 from rag_llm_k8s_tpu.rag import lookahead as lookahead_mod
@@ -182,6 +183,26 @@ class RagService:
             )
             if fl is not None else None
         )
+        # shadow-traffic quality auditor (obs/shadow.py): a sampled
+        # fraction of completed requests re-runs on the EXACT path (the
+        # one-shot engine's teacher-forced scorer — reuse off, speculation
+        # off, native-dtype KV; the continuous pool's blocks are never
+        # touched) and every divergence is attributed to the
+        # approximations that served the request. Rides the lookahead
+        # executor's headroom gate so audits never compete with live
+        # traffic. On by default (ShadowConfig).
+        self.shadow = None
+        self._shadow_stats_memo = None
+        sh_cfg = getattr(config, "shadow", None)
+        if sh_cfg is not None and sh_cfg.enabled and engine is not None \
+                and hasattr(engine, "score_exact"):
+            self.shadow = obs_shadow.ShadowAuditor(
+                sh_cfg,
+                score_fn=engine.score_exact,
+                headroom_fn=self._lookahead_headroom,
+                on_result=self._on_shadow_result,
+                on_burst=lambda: self.record_incident("quality_divergence"),
+            )
         self._init_observability()
         # incident triggers (obs/flight.py): the breaker flip and the
         # reset storm snapshot the journal that explains them; the
@@ -429,27 +450,43 @@ class RagService:
         if int(getattr(sched_eng, "B", 0) or 0) > 0:
             # continuous mode only: a labeled family with ZERO children
             # would appear in the JSON snapshot but not the text
-            # exposition (the equivalence test_obs pins), so the per-row
-            # family exists exactly where rows exist
+            # exposition (the equivalence test_obs pins), so the family
+            # exists exactly where rows exist. Rows are BUCKETED, never
+            # per-row: a B=256 deployment must not register 256 children
+            # per scrape — the registry's cardinality is a fleet-wide
+            # scrape cost, and the adaptive-K controller only needs the
+            # cohort view (a collapsing bucket mean is the same remedy
+            # signal the RUNBOOK's speculation entry reads)
             spec_rows = reg.labeled_gauge(
                 "rag_spec_acceptance_rate",
-                "per-slot decayed draft-acceptance rate (accepted/offered "
-                "EMA; 0 while the slot is empty or has no evidence) — the "
-                "adaptive-K controller's input: rows below "
+                "decayed draft-acceptance rate (accepted/offered EMA) "
+                "averaged over the ACTIVE slots in each row bucket (row: "
+                "row_lt_8 | row_lt_64 | row_ge_64; 0 while the bucket has "
+                "no active rows or no evidence) — the adaptive-K "
+                "controller's input: rows below "
                 "TPU_RAG_SPEC_PAGED_MIN_ACCEPT degrade to K=1",
             )
-            for i in range(int(sched_eng.B)):
+
+            def _bucket_mean(lo: int, hi: int, e=sched_eng) -> float:
                 # reading the slot list from the scrape thread is safe:
                 # the engine replaces slots wholesale (never mutates one
                 # into an inconsistent state) and a stale EMA read is
                 # gauge-grade
-                spec_rows.labels_callback(
-                    lambda i=i, e=sched_eng: (
-                        float(e.slots[i].spec_ema or 0.0)
-                        if e.slots[i].active else 0.0
-                    ),
-                    row=str(i),
-                )
+                vals = [
+                    float(s.spec_ema or 0.0)
+                    for s in e.slots[lo:hi] if s.active
+                ]
+                return sum(vals) / len(vals) if vals else 0.0
+
+            for name, lo, hi in (
+                ("row_lt_8", 0, 8),
+                ("row_lt_64", 8, 64),
+                ("row_ge_64", 64, 1 << 30),
+            ):
+                if lo < int(sched_eng.B):
+                    spec_rows.labels_callback(
+                        lambda lo=lo, hi=hi: _bucket_mean(lo, hi), row=name
+                    )
         # KV prefix cache: prompt tokens whose prefill was skipped because
         # their KV spliced from a cached block — computed (prefill_tokens)
         # + skipped = logical prompt total
@@ -637,6 +674,73 @@ class RagService:
         )
         for t in obs_flight.TRIGGERS:
             self._m_incidents.labels(trigger=t)
+        # shadow quality auditor (obs/shadow.py, docs/OBSERVABILITY.md
+        # "Shadow quality auditor"): sampled exact-path re-execution of
+        # completed requests — audit outcomes, divergence rate, logit-err
+        # and first-divergence distributions, and per-approximation
+        # attribution. Families exist in every mode (zeros while the
+        # auditor is off) so dashboards stay uniform; counters are
+        # callback-valued off one memoized stats snapshot per scrape.
+        q_audits = reg.labeled_counter(
+            "rag_quality_audits_total",
+            "shadow audits by outcome (clean — delivered stream matches "
+            "the exact path's argmax chain; diverged — it doesn't; "
+            "skipped — selected but unjudgeable, see "
+            "rag_quality_skipped_total; failed — the audit itself crashed)",
+        )
+        for oc in ("clean", "diverged", "skipped", "failed"):
+            q_audits.labels_callback(
+                lambda oc=oc: self._shadow_stats().get(f"audits_{oc}", 0.0),
+                outcome=oc,
+            )
+        q_skip = reg.labeled_counter(
+            "rag_quality_skipped_total",
+            "sampler-selected audits that could not run (reason: sampled "
+            "— non-greedy stream has no deterministic exact reference; "
+            "empty | no_prompt | oversize — nothing comparable; backlog | "
+            "headroom — live traffic kept the device busy)",
+        )
+        for r in obs_shadow.SKIP_REASONS:
+            q_skip.labels_callback(
+                lambda r=r: self._shadow_stats().get(f"skip_{r}", 0.0),
+                reason=r,
+            )
+        reg.gauge(
+            "rag_quality_divergence_rate",
+            "diverged / (clean + diverged) over all judged shadow audits "
+            "— 0.0 is the byte-identity contracts holding on live traffic",
+            fn=lambda: self._shadow_stats().get("divergence_rate", 0.0),
+        )
+        q_attr = reg.labeled_counter(
+            "rag_quality_attribution_total",
+            "judged shadow audits per ACTIVE approximation in the "
+            "request's fingerprint (approximation: prefix_reuse | "
+            "warm_tier | splice | rerotate | boundary_fixup | spec_verify "
+            "| none; outcome: clean | diverged) — a diverging "
+            "approximation names itself here",
+        )
+        for a in obs_shadow.APPROXIMATIONS + ("none",):
+            for oc in ("clean", "diverged"):
+                q_attr.labels_callback(
+                    lambda a=a, oc=oc: self._shadow_stats().get(
+                        f"attr_{a}_{oc}", 0.0
+                    ),
+                    approximation=a, outcome=oc,
+                )
+        self._m_quality_err = reg.histogram(
+            "rag_quality_logit_err",
+            "per-audit minimal explaining logit perturbation (0.0 on "
+            "clean audits; the 0.15 bucket bound IS the pinned warm/"
+            "splice tolerance the quality_p99_logit_err SLO evaluates at)",
+            buckets=tuple(float(b) for b in obs_shadow.ERR_BUCKETS),
+        )
+        self._m_quality_first_div = reg.histogram(
+            "rag_quality_first_divergence_token",
+            "emitted position of the first exact-vs-delivered token "
+            "disagreement, per diverged shadow audit (early divergence = "
+            "prompt-side approximation; late = accumulated drift)",
+            buckets=tuple(float(b) for b in obs_shadow.POS_BUCKETS),
+        )
         # goodput ledger (obs/goodput.py, docs/GOODPUT.md): per-window
         # chip-time attribution fractions, rolling MFU / bandwidth
         # utilization per executable kind, and the NinjaLLM cost framing
@@ -923,6 +1027,107 @@ class RagService:
                 retier(_cache.chain_tier)
 
         sched.run_on_engine(_retier_task)
+
+    # -- shadow quality auditor (obs/shadow.py) --------------------------
+    def _shadow_stats(self) -> Dict[str, float]:
+        """Flat snapshot behind the ~20 rag_quality_* callbacks, memoized
+        for a beat like the tier-stats snapshot (one auditor-lock take
+        serves the whole scrape; benign race on the memo)."""
+        if self.shadow is None:
+            return {}
+        now = time.monotonic()
+        cached = self._shadow_stats_memo
+        if cached is not None and now - cached[0] < 0.25:
+            return cached[1]
+        out = self.shadow.stats()
+        self._shadow_stats_memo = (now, out)
+        return out
+
+    def _on_shadow_result(self, request_id, ev: Dict) -> None:
+        """Auditor result hook (worker thread): journal the audit as a
+        flight event — the facts ``flightview --quality`` rebuilds the
+        report from — feed the quality histograms (the SLO's SLI source),
+        and journal the divergence itself when there is one."""
+        obs_flight.emit("shadow_audit", request_id, **ev)
+        oc = ev.get("outcome")
+        if oc in ("clean", "diverged"):
+            self._m_quality_err.observe(float(ev.get("err", 0.0)))
+        if oc == "diverged":
+            self._m_quality_first_div.observe(float(ev.get("pos", 0)))
+            obs_flight.emit(
+                "quality_divergence", request_id,
+                pos=ev.get("pos"), err=ev.get("err"),
+                approx=ev.get("approx") or [],
+            )
+
+    @staticmethod
+    def _approx_fingerprint(gen_info: Optional[Dict], cp=None
+                            ) -> Tuple[str, ...]:
+        """One request's approximation fingerprint: the prefix cache's
+        per-resolve marks (CachedPrefix.approx) plus whatever the engine
+        stamped into the ``info`` out-param (speculation, via the per-
+        request ledger stats on the continuous path)."""
+        ap = set()
+        if cp is not None:
+            ap.update(getattr(cp, "approx", ()) or ())
+        gi = gen_info or {}
+        ap.update(gi.get("approx", ()) or ())
+        gp = gi.get("goodput") or {}
+        if gp.get("spec_drafted"):
+            ap.add("spec_verify")
+        return tuple(sorted(ap))
+
+    def _shadow_observe(self, served_by, out_ids, gen_info: Optional[Dict],
+                        prompt_ids=None, prompt_fn=None, cp=None) -> None:
+        """Offer one delivered response to the shadow auditor (sampling,
+        backlog and headroom discipline live in the auditor). Non-greedy
+        streams are ineligible — without the row's keyed draws the exact
+        path has no deterministic reference — and are counted as such
+        only when the sampler actually selected them. Never raises: an
+        audit must not fail the response it rides on."""
+        sh = self.shadow
+        if sh is None:
+            return
+        try:
+            s = getattr(served_by, "sampling", None)
+            eligible = not (
+                s is not None and s.do_sample and s.temperature > 0.0
+            )
+            sh.observe(
+                emitted=list(out_ids),
+                approx=self._approx_fingerprint(gen_info, cp),
+                request_id=(gen_info or {}).get("request_id"),
+                prompt_ids=prompt_ids,
+                prompt_fn=prompt_fn,
+                eligible=eligible,
+            )
+        except Exception:  # noqa: BLE001 — auditing must not fail serving
+            logger.exception("shadow observe failed")
+
+    def quality_report(self) -> Dict:
+        """The live quality picture ``GET /debug/quality`` serves. The
+        ``report`` half is rendered by the SAME function
+        ``scripts/flightview.py --quality`` applies to a journal/bundle's
+        ``shadow_audit`` events offline, so the two cannot drift;
+        ``sampling`` carries the auditor-local facts (seen/selected) the
+        journal deliberately does not."""
+        sh = self.shadow
+        if sh is None:
+            return {
+                "enabled": False,
+                "report": obs_shadow.render_report(obs_shadow.new_state()),
+            }
+        stats = sh.stats()
+        return {
+            "enabled": True,
+            "report": obs_shadow.render_report(sh.state()),
+            "sampling": {
+                "sample_rate": sh.config.sample_rate,
+                "seen": int(stats.get("seen", 0)),
+                "selected": int(stats.get("selected", 0)),
+                "backlog_depth": int(stats.get("backlog_depth", 0)),
+            },
+        }
 
     def _prefix_bytes_by_device(self) -> Dict[int, int]:
         """{device_id: prefix-cache bytes} summed over the serving engines
@@ -1614,8 +1819,12 @@ class RagService:
 
             t0 = time.monotonic()
             gen_info: Dict[str, float] = {}
+            served_engine = self.engine  # shadow audit: whose sampling rules
             with tracing.span("generate"):
                 if self.scheduler is not None and len(prompt_ids) <= self._scheduler_prompt_cap():
+                    served_engine = (
+                        getattr(self.scheduler, "engine", None) or self.engine
+                    )
                     try:
                         out_ids = self.scheduler.submit(
                             prompt_ids, deadline=deadline, info=gen_info
@@ -1677,6 +1886,11 @@ class RagService:
         self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
         self.metrics.inc("query_decode_tokens", len(out_ids))
         self._observe_request(timings)
+        # shadow quality audit (sampled): the delivered stream vs the
+        # exact path — the prompt is the exact token list that served
+        self._shadow_observe(
+            served_engine, out_ids, gen_info, prompt_ids=prompt_ids
+        )
         resp = {
             "generated_text": extract_answer(completion),
             "context": context,
@@ -1796,6 +2010,15 @@ class RagService:
         self.metrics.inc("query_decode_tokens", len(out_ids))
         self.metrics.inc("query_prefix_cached", 1)
         self._observe_request(timings)
+        # shadow quality audit: the prompt as served is the segment chain
+        # + tail, and the resolve's CachedPrefix carries the fingerprint
+        # (prefix_reuse / warm_tier / splice / rerotate / boundary_fixup)
+        # any divergence is attributed to
+        self._shadow_observe(
+            self.engine, out_ids, gen_info,
+            prompt_ids=[t for _, seg in segments for t in seg] + list(b_ids),
+            cp=cp,
+        )
         return {
             "generated_text": extract_answer(completion),
             "context": context,
@@ -1906,6 +2129,18 @@ class RagService:
         self.metrics.inc("query_decode_tokens", len(out_ids))
         self.metrics.inc("query_single_fetch", 1)
         self._observe_request(timings)
+        # shadow quality audit: the prompt was assembled ON DEVICE, so
+        # its token ids are reconstructed from the host mirror (pinned
+        # token-identical to the device assembly) — and only when the
+        # sampler actually selects this request (prompt_fn defers the
+        # re-tokenize the 95% unsampled case must not pay)
+        self._shadow_observe(
+            self.engine, out_ids, gen_info,
+            prompt_fn=lambda: (
+                (self._piecewise_prompt(user_prompt, results) or (None, None)
+                 )[1]
+            ),
+        )
         return {
             "generated_text": extract_answer(completion),
             "context": context,
@@ -2151,6 +2386,10 @@ class RagService:
         """Stop the serving threads (coalescers/schedulers) and release the
         store's device sidecar (the store may outlive this service; its HBM
         must not). Idempotent."""
+        if self.shadow is not None:
+            # first: the audit worker drives the one-shot engine, which
+            # must outlive any in-flight audit
+            self.shadow.shutdown()
         if self.lookahead is not None:
             # before the coalescer: lookahead workers submit into it
             self.lookahead.shutdown()
@@ -2200,6 +2439,8 @@ class WsgiApp:
                 Rule("/debug/incidents", endpoint="debug_incidents",
                      methods=["GET"]),
                 Rule("/debug/goodput", endpoint="debug_goodput",
+                     methods=["GET"]),
+                Rule("/debug/quality", endpoint="debug_quality",
                      methods=["GET"]),
             ]
         )
@@ -2541,6 +2782,23 @@ class WsgiApp:
             return self._jsonify(self.service.goodput_report())
         except Exception as e:  # noqa: BLE001
             logger.exception("goodput report failed")
+            return self._jsonify({"error": str(e)}, 500)
+
+    def ep_debug_quality(self, request):
+        """The shadow auditor's quality report (obs/shadow.py,
+        docs/OBSERVABILITY.md "Shadow quality auditor"): audit outcomes,
+        divergence rate, logit-err / first-divergence distributions, and
+        per-approximation attribution — the live measurement of every
+        approximation contract in the serving path. Same 403-unless-armed
+        contract as every ``/debug`` route;
+        ``scripts/flightview.py --quality`` rebuilds the same report
+        offline from a journal or incident bundle."""
+        if not self._debug_enabled():
+            return self._debug_forbidden()
+        try:
+            return self._jsonify(self.service.quality_report())
+        except Exception as e:  # noqa: BLE001
+            logger.exception("quality report failed")
             return self._jsonify({"error": str(e)}, 500)
 
     def ep_debug_faults(self, request):

@@ -76,6 +76,11 @@ REQUIRED_KEYS = (
     # continuous decode; acceptance ≤ 2%) — the ledger is ON by default,
     # so its overhead may never go unjudged in a bench round
     "goodput_overhead.overhead_frac",
+    # ISSUE 15: the shadow quality auditor's measured cost (audits-on vs
+    # -off B=8 continuous decode at the default 5% sample rate;
+    # acceptance ≤ 2%) — the auditor is ON by default, so its overhead
+    # may never go unjudged in a bench round
+    "shadow_overhead.overhead_frac",
 )
 
 

@@ -14,7 +14,12 @@ copied off a pod's spool directory) — or a bare journal dump — into:
   percentiles, rebuilt from the journal's ``goodput_window``/``complete``
   events by the SAME renderer ``GET /debug/goodput`` uses live
   (rag_llm_k8s_tpu/obs/goodput.py, loaded by file path so no jax is
-  pulled in) — the two reports cannot drift apart.
+  pulled in) — the two reports cannot drift apart;
+- **the shadow quality report** (``--quality``): audit outcomes,
+  divergence rate, logit-err/first-divergence distributions and
+  per-approximation attribution, rebuilt from the journal's
+  ``shadow_audit`` events by the SAME renderer ``GET /debug/quality``
+  uses live (rag_llm_k8s_tpu/obs/shadow.py, same jax-free contract).
 
 No live pod, no jax, no third-party deps — a bundle is self-contained by
 contract (docs/OBSERVABILITY.md "Engine flight recorder").
@@ -24,6 +29,7 @@ Usage:
     python scripts/flightview.py BUNDLE.json --json     # structured form
     python scripts/flightview.py BUNDLE.json --request 7
     python scripts/flightview.py BUNDLE.json --goodput [--chip-hour-usd X]
+    python scripts/flightview.py BUNDLE.json --quality
 
 Input shapes accepted: a full incident bundle (``{"journal": [...],
 "trigger": ..., ...}``), a journal-only dump (``{"journal": [...]}``), or
@@ -171,21 +177,25 @@ def render_ascii(view: Dict, meta: Optional[Dict] = None) -> str:
     return "\n".join(lines)
 
 
-def _load_goodput_module():
-    """Load obs/goodput.py DIRECTLY by file path: importing the package
+def _load_obs_module(name: str):
+    """Load an obs/ module DIRECTLY by file path: importing the package
     would execute ``rag_llm_k8s_tpu.obs.__init__`` (which pulls tracing →
     jax), and flightview must run on a laptop holding nothing but the
-    bundle. goodput.py is stdlib-only by contract."""
+    bundle. goodput.py and shadow.py are stdlib-only by contract."""
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), os.pardir,
-        "rag_llm_k8s_tpu", "obs", "goodput.py",
+        "rag_llm_k8s_tpu", "obs", f"{name}.py",
     )
-    spec = importlib.util.spec_from_file_location("_flightview_goodput", path)
+    spec = importlib.util.spec_from_file_location(f"_flightview_{name}", path)
     if spec is None or spec.loader is None:
-        raise SystemExit(f"flightview: cannot load goodput module at {path}")
+        raise SystemExit(f"flightview: cannot load {name} module at {path}")
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_goodput_module():
+    return _load_obs_module("goodput")
 
 
 def build_goodput_report(events: List[Dict],
@@ -197,6 +207,45 @@ def build_goodput_report(events: List[Dict],
     return gp.render_report(
         gp.state_from_events(events), chip_hour_usd=chip_hour_usd
     )
+
+
+def build_quality_report(events: List[Dict]) -> Dict:
+    """The offline half of the quality same-report contract: rebuild the
+    auditor state from ``shadow_audit`` events and render with the exact
+    function ``GET /debug/quality`` uses live (obs/shadow.py)."""
+    sh = _load_obs_module("shadow")
+    return sh.render_report(sh.state_from_events(events))
+
+
+def render_quality_ascii(report: Dict) -> str:
+    a = report["audits"]
+    lines = [
+        "shadow quality report",
+        f"  audits: clean={a['clean']}  diverged={a['diverged']}"
+        f"  skipped={a['skipped']}  failed={a['failed']}"
+        f"  divergence_rate={report['divergence_rate']:.6f}",
+        f"  tokens compared: {report['tokens_compared']}",
+    ]
+    if report["skips"]:
+        lines.append("  skips: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(report["skips"].items())
+        ))
+    lines.append("  attribution (audits per active approximation):")
+    for approx, v in report["attribution"].items():
+        lines.append(
+            f"    {approx:<16} clean={v['clean']:<6} diverged={v['diverged']}"
+        )
+    le = report["logit_err"]
+    lines.append(
+        f"  logit_err: p50={le['p50']}  p99={le['p99']}  max={le['max']}"
+    )
+    fd = report["first_divergence_token"]
+    lines.append(f"  first divergence token: p50={fd['p50']}")
+    lines.append("  logit_err histogram:")
+    for lbl, n in le["hist"].items():
+        if n:
+            lines.append(f"    {lbl:<10} {n}")
+    return "\n".join(lines)
 
 
 def render_goodput_ascii(report: Dict) -> str:
@@ -251,6 +300,10 @@ def main(argv=None) -> int:
                     help="render the goodput/cost report rebuilt from the "
                          "journal's goodput_window events instead of the "
                          "lifecycle view")
+    ap.add_argument("--quality", action="store_true",
+                    help="render the shadow-audit quality report rebuilt "
+                         "from the journal's shadow_audit events instead "
+                         "of the lifecycle view")
     ap.add_argument("--chip-hour-usd", type=float, default=0.0,
                     help="chip rental price for the --goodput cost figures "
                          "(defaults to 0: attribution only, no dollars)")
@@ -262,6 +315,13 @@ def main(argv=None) -> int:
         print(f"flightview: cannot read {args.bundle}: {e}", file=sys.stderr)
         return 2
     events = load_events(doc)
+    if args.quality:
+        report = build_quality_report(events)
+        if args.as_json:
+            print(json.dumps(report, indent=1))
+        else:
+            print(render_quality_ascii(report))
+        return 0
     if args.goodput:
         report = build_goodput_report(
             events, chip_hour_usd=args.chip_hour_usd

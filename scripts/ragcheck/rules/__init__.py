@@ -7,6 +7,7 @@ from scripts.ragcheck.rules.config_drift import ConfigDriftRule
 from scripts.ragcheck.rules.fault_sites import FaultSiteRegistryRule
 from scripts.ragcheck.rules.metric_drift import MetricDriftRule
 from scripts.ragcheck.rules.event_registry import EventRegistryRule
+from scripts.ragcheck.rules.debug_gate import DebugGateRule
 
 ALL_RULES = [
     LockDisciplineRule,
@@ -16,6 +17,7 @@ ALL_RULES = [
     FaultSiteRegistryRule,
     MetricDriftRule,
     EventRegistryRule,
+    DebugGateRule,
 ]
 
 __all__ = ["ALL_RULES"]

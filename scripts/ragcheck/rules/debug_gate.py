@@ -1,0 +1,118 @@
+"""DEBUG-GATE: every /debug/* route handler goes through the 403 gate.
+
+PR 11 established the discipline by hand: the debug surface (traces,
+timelines, incident bundles, fault arming, goodput, quality) answers 403
+unless the process started armed (``TPU_RAG_FAULTS`` / ``TPU_RAG_DEBUG``)
+— a production pod must not leak its journal, config fingerprints or
+fault controls to anyone who can reach the port. But nothing enforced it:
+the next ``/debug/foo`` route was one forgotten ``if`` away from shipping
+ungated, and the uniform-gating test only covers routes someone
+remembered to list.
+
+This rule mechanizes it at the source: every ``Rule("/debug/...",
+endpoint=<name>)`` registration in the server module must map to an
+``ep_<name>`` handler whose body calls one of the sanctioned gates —
+``self._debug_enabled()`` (the uniform read-only gate) or
+``faults.endpoint_enabled()`` (the stricter fault-arming gate) — before
+it can serve anything.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional
+
+from scripts.ragcheck.core import Finding, Repo
+
+SERVER_MODULE = "rag_llm_k8s_tpu/server/app.py"
+
+#: calls that count as "the handler is gated" — the uniform read-only
+#: gate, or the fault endpoint's stricter own gate
+GATES = ("_debug_enabled", "endpoint_enabled")
+
+
+def _debug_routes(tree: ast.AST) -> Dict[str, int]:
+    """``endpoint name -> lineno`` for every Rule("/debug...") call."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None
+        )
+        if name != "Rule" or not node.args:
+            continue
+        path = node.args[0]
+        if not (isinstance(path, ast.Constant) and isinstance(path.value, str)):
+            continue
+        if not path.value.startswith("/debug"):
+            continue
+        endpoint: Optional[str] = None
+        for kw in node.keywords:
+            if kw.arg == "endpoint" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                endpoint = kw.value.value
+        if endpoint is not None:
+            out.setdefault(endpoint, node.lineno)
+    return out
+
+
+def _handlers(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name.startswith("ep_"):
+            out[node.name[len("ep_"):]] = node
+    return out
+
+
+def _is_gated(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in GATES:
+            return True
+    return False
+
+
+class DebugGateRule:
+    id = "DEBUG-GATE"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        sf = repo.get(SERVER_MODULE)
+        if sf is None or sf.tree is None:
+            return  # no server module in this tree (fixture repos)
+        routes = _debug_routes(sf.tree)
+        if not routes:
+            return
+        handlers = _handlers(sf.tree)
+        for endpoint, line in sorted(routes.items()):
+            fn = handlers.get(endpoint)
+            if fn is None:
+                yield Finding(
+                    rule=self.id,
+                    path=sf.path,
+                    line=line,
+                    message=(
+                        f"/debug route endpoint {endpoint!r} has no "
+                        f"ep_{endpoint} handler in {SERVER_MODULE} — the "
+                        "URL map names a handler that cannot be audited"
+                    ),
+                    key=f"missing-handler:{endpoint}",
+                )
+                continue
+            if not _is_gated(fn):
+                yield Finding(
+                    rule=self.id,
+                    path=sf.path,
+                    line=fn.lineno,
+                    message=(
+                        f"ep_{endpoint} serves a /debug route without "
+                        "calling self._debug_enabled() or "
+                        "faults.endpoint_enabled() — every /debug handler "
+                        "must 403 unless the process started armed "
+                        "(TPU_RAG_FAULTS / TPU_RAG_DEBUG); see "
+                        "docs/OBSERVABILITY.md 'Debug-surface gating'"
+                    ),
+                    key=f"ungated-debug-route:{endpoint}",
+                )

@@ -329,6 +329,8 @@ class TestDebugGating:
         "/debug/timeline/1",
         "/debug/incidents",
         "/debug/faults",
+        "/debug/goodput",
+        "/debug/quality",
     )
 
     @pytest.mark.parametrize("route", ROUTES)

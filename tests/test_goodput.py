@@ -479,6 +479,100 @@ class TestSmoke:
 
 
 # ---------------------------------------------------------------------------
+# dual-engine debug surfaces (ISSUE 15 satellite): the merged-ledger and
+# spool paths were only ever exercised single-engine — pin them with BOTH
+# serving engines live and attributed concurrently
+# ---------------------------------------------------------------------------
+class TestDualEngineDebug:
+    def _drive_both_engines(self, svc):
+        """Concurrent traffic on BOTH substrates: continuous submits race
+        one-shot generates, so each engine's ledger accrues windows in
+        the same wall-clock span the merged report covers."""
+        errs = []
+
+        def sched_traffic():
+            try:
+                for i in range(3):
+                    svc.scheduler.submit([5 + i, 7, 9, 7, 9], timeout=120)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        def oneshot_traffic():
+            try:
+                for i in range(2):
+                    svc.engine.generate([[3 + i, 8, 11]])
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=sched_traffic),
+            threading.Thread(target=oneshot_traffic),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs
+
+    def test_debug_goodput_merges_both_engines(
+        self, goodput_service, monkeypatch
+    ):
+        """/debug/goodput from a service running BOTH engines: the merged
+        report carries continuous-side kinds (decode/prefill) AND the
+        one-shot kind in one consistent rendering, with the category
+        fractions still summing to 1 over the merged busy time."""
+        svc = goodput_service
+        self._drive_both_engines(svc)
+        monkeypatch.setenv("TPU_RAG_FAULTS", "1")
+        client = create_app(svc).test_client()
+        report = client.get("/debug/goodput").get_json()
+        kinds = report["kinds"]
+        assert kinds.get("oneshot", {}).get("windows", 0) > 0, (
+            "one-shot engine's ledger missing from the merged report"
+        )
+        assert (
+            kinds.get("decode", {}).get("windows", 0) > 0
+            or kinds.get("prefill", {}).get("windows", 0) > 0
+        ), "continuous engine's ledger missing from the merged report"
+        fracs = sum(
+            v["frac"] for c, v in report["categories"].items() if c != "idle"
+        )
+        assert fracs == pytest.approx(1.0, rel=1e-4)
+        # busy time merges as a SUM over engines; each engine's own busy
+        # is bounded by it
+        for e in (svc.engine, svc.scheduler.engine):
+            assert e.ledger.state()["busy_s"] <= report["busy_s"] + 1e-9
+
+    def test_debug_incidents_spools_and_serves_with_both_engines(
+        self, goodput_service, monkeypatch
+    ):
+        """/debug/incidents from the same dual-engine service: a bundle
+        spooled while both engines journal captures goodput_window events
+        from BOTH (oneshot + continuous kinds) in one journal, and the
+        spool round-trips it."""
+        svc = goodput_service
+        self._drive_both_engines(svc)
+        bid = svc.record_incident("deadline_exceeded")
+        assert bid is not None
+        monkeypatch.setenv("TPU_RAG_FAULTS", "1")
+        client = create_app(svc).test_client()
+        listing = client.get("/debug/incidents").get_json()["incidents"]
+        assert any(i["id"] == bid for i in listing)
+        bundle = client.get(f"/debug/incidents?id={bid}").get_json()
+        assert bundle["meta"]["engine_mode"] == "continuous"
+        gw_kinds = {
+            e.get("kind") for e in bundle["journal"]
+            if e["type"] == "goodput_window"
+        }
+        assert "oneshot" in gw_kinds, (
+            "bundle journal missing the one-shot engine's windows"
+        )
+        assert gw_kinds & {"decode", "prefill", "verify"}, (
+            "bundle journal missing the continuous engine's windows"
+        )
+
+
+# ---------------------------------------------------------------------------
 # per-request speculation stats in /generate timings (satellite)
 # ---------------------------------------------------------------------------
 class TestSpecStats:

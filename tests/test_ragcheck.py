@@ -21,6 +21,7 @@ if str(REPO_ROOT) not in sys.path:
 
 from scripts.ragcheck import core  # noqa: E402
 from scripts.ragcheck.rules.config_drift import ConfigDriftRule  # noqa: E402
+from scripts.ragcheck.rules.debug_gate import DebugGateRule  # noqa: E402
 from scripts.ragcheck.rules.event_registry import EventRegistryRule  # noqa: E402
 from scripts.ragcheck.rules.fault_sites import FaultSiteRegistryRule  # noqa: E402
 from scripts.ragcheck.rules.jit_hygiene import JitHygieneRule  # noqa: E402
@@ -615,6 +616,80 @@ class TestEventRegistry:
                     flight.emit("reset")
                 """,
             "docs/OBSERVABILITY.md": _EVENTS_DOC,
+        })
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# DEBUG-GATE
+# ---------------------------------------------------------------------------
+
+
+class TestDebugGate:
+    def test_flags_ungated_debug_route(self, tmp_path):
+        fs = run_rule(tmp_path, DebugGateRule, {
+            "rag_llm_k8s_tpu/server/app.py": """
+                class WsgiApp:
+                    def __init__(self):
+                        self.url_map = Map([
+                            Rule("/debug/stuff", endpoint="debug_stuff",
+                                 methods=["GET"]),
+                            Rule("/healthz", endpoint="healthz"),
+                        ])
+
+                    def _debug_enabled(self):
+                        return False
+
+                    def ep_debug_stuff(self, request):
+                        return {"secret": "journal"}  # no gate call
+
+                    def ep_healthz(self, request):
+                        return {"ok": True}  # non-debug: no gate needed
+                """,
+        })
+        assert keys(fs) == {"ungated-debug-route:debug_stuff"}
+
+    def test_flags_missing_handler(self, tmp_path):
+        fs = run_rule(tmp_path, DebugGateRule, {
+            "rag_llm_k8s_tpu/server/app.py": """
+                class WsgiApp:
+                    def __init__(self):
+                        self.url_map = Map([
+                            Rule("/debug/ghost", endpoint="debug_ghost"),
+                        ])
+                """,
+        })
+        assert keys(fs) == {"missing-handler:debug_ghost"}
+
+    def test_compliant_twin_is_silent(self, tmp_path):
+        fs = run_rule(tmp_path, DebugGateRule, {
+            "rag_llm_k8s_tpu/server/app.py": """
+                class WsgiApp:
+                    def __init__(self):
+                        self.url_map = Map([
+                            Rule("/debug/stuff", endpoint="debug_stuff"),
+                            Rule("/debug/faults", endpoint="debug_faults"),
+                        ])
+
+                    def _debug_enabled(self):
+                        return False
+
+                    def ep_debug_stuff(self, request):
+                        if not self._debug_enabled():
+                            return 403
+                        return {"ok": True}
+
+                    def ep_debug_faults(self, request):
+                        if not faults.endpoint_enabled():
+                            return 403
+                        return {"ok": True}
+                """,
+        })
+        assert fs == []
+
+    def test_no_server_module_is_silent(self, tmp_path):
+        fs = run_rule(tmp_path, DebugGateRule, {
+            "rag_llm_k8s_tpu/mod.py": "x = 1\n",
         })
         assert fs == []
 
