@@ -2515,6 +2515,146 @@ def measure_continuous_spec() -> dict:
     return {"continuous_spec": out}
 
 
+def measure_chunked_prefill() -> dict:
+    """Unified ragged sync windows (ISSUE 16 acceptance leg): heavy
+    admission churn — waves of fresh prompts arriving while the batch
+    decodes — chunked prefill interleaved into decode windows vs the
+    phase-separated scheduler, same zero-params 1B construction as
+    ``continuous_spec``. Reports the goodput ledger's padding-bubble and
+    useful-decode shares of busy chip time, the p95 inter-token gap
+    during the churn phase (the stall decode rows eat while admissions
+    land — phase-separated pays whole prompts between windows,
+    interleaved pays one chunk inside each), and TTFT p95. Greedy
+    identity recorded, not asserted (per-kernel numerics can
+    argmax-diverge on a bf16 near-tie — ADVICE r4 #2; the byte-identity
+    contract is pinned in fp32 on CPU by tests/test_chunked_prefill.py).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from rag_llm_k8s_tpu.core.config import (
+        DTypePolicy,
+        EngineConfig,
+        LlamaConfig,
+        SamplingConfig,
+    )
+    from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine
+    from rag_llm_k8s_tpu.models.llama import init_llama_params
+
+    config = LlamaConfig.llama_3_2_1b()
+    dtypes = DTypePolicy()
+    shapes = jax.eval_shape(
+        lambda: init_llama_params(jax.random.PRNGKey(0), config, dtypes)
+    )
+    params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    # admission-dominated churn: waves of 6 long prompts that bucket badly
+    # (260 of 512 → the phase-separated prefill grid is half pad) against
+    # an 8-row decode batch with short answers. The interleaved window
+    # budget admits a full wave's chunks per window (6 × 64 + decode), so
+    # most rows carry real chunk lanes while decode never stops — the
+    # shape the phase-separated scheduler burns as bucket pad + stalls.
+    PLEN, BUCKET, BS, NEW = 256, 512, 16, 12
+    BATCH_C, TOTAL, CHUNK, WAVE = 8, 24, 64, 6
+    prompt = [config.bos_token_id] + [7, 8, 9, 10] * ((PLEN - 1) // 4)
+    sampling = SamplingConfig(do_sample=False, max_new_tokens=NEW)
+    horizon_blocks = -(-(BUCKET + NEW + 8) // BS) + 1
+
+    def p95(xs):
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return xs[int(0.95 * (len(xs) - 1))]
+
+    def run(interleave: bool):
+        ec = EngineConfig(
+            prompt_buckets=(BUCKET,), max_batch_size=BATCH_C,
+            max_seq_len=BUCKET + NEW + 16, kv_paged=True, kv_block_size=BS,
+            kv_pool_blocks=BATCH_C * horizon_blocks,
+            interleave_prefill=interleave, prefill_chunk_tokens=CHUNK,
+            window_token_budget=BATCH_C + WAVE * CHUNK,
+        )
+        eng = ContinuousEngine(
+            config, params, sampling=sampling, engine_config=ec,
+            dtypes=dtypes,
+        )
+        eng.warmup(batch_sizes=(BATCH_C,))
+        outs, ttft, t_sub, gaps = {}, {}, {}, []
+        queued = set()  # interleaved admissions awaiting their tok0
+        next_rid, pending = 0, TOTAL
+
+        def admit(n):
+            nonlocal next_rid, pending
+            k = min(n, len(eng.free_slots()), pending)
+            if k <= 0:
+                return
+            items = []
+            for _ in range(k):
+                rid = next_rid
+                next_rid += 1
+                t_sub[rid] = time.monotonic()
+                items.append((rid, prompt, NEW, None))
+            pending -= k
+            res = eng.admit_many(items)
+            now = time.monotonic()
+            for (rid, _, _, _), r in zip(items, res):
+                if isinstance(r, BaseException):
+                    raise r
+                if interleave:
+                    queued.add(rid)  # tok0 arrives at the final chunk
+                else:
+                    ttft[rid] = now - t_sub[rid]  # tok0 sampled at prefill
+                if r[1] is not None:
+                    outs[rid] = r[1]
+
+        admit(WAVE)  # first wave, then churn in waves as rows free up
+        last = time.monotonic()
+        steps = 0
+        for _ in range(100000):
+            if not (eng.has_active() or eng._chunk_admissions or pending):
+                break
+            churn = pending > 0 or bool(eng._chunk_admissions)
+            if pending and steps % 2 == 0:
+                admit(WAVE)
+            for rid, toks in eng.step():
+                outs[rid] = toks
+            now = time.monotonic()
+            for rid in [r for r in queued if r not in eng._chunk_admissions]:
+                ttft[rid] = now - t_sub[rid]
+                queued.discard(rid)
+            # the gap a decoding row experienced since the last window
+            # retired a token — admission work between windows included
+            if churn and steps > 0:
+                gaps.append(now - last)
+            last = now
+            steps += 1
+        st = eng.ledger.state()
+        busy = max(st["busy_s"], 1e-9)
+        del eng
+        return {
+            "bubble": st["categories"]["padding_bubble"] / busy,
+            "useful": st["categories"]["decode_useful"] / busy,
+            "itl_p95": p95(gaps),
+            "ttft_p95": p95(list(ttft.values())),
+            "streams": [outs.get(i, []) for i in range(TOTAL)],
+        }
+
+    off = run(False)
+    on = run(True)
+    return {"chunked_prefill": {
+        "bubble_frac": round(on["bubble"], 4),
+        "bubble_frac_phase_sep": round(off["bubble"], 4),
+        "decode_useful_frac": round(on["useful"], 4),
+        "decode_useful_frac_phase_sep": round(off["useful"], 4),
+        "itl_p95_ms_churn": round(on["itl_p95"] * 1e3, 2),
+        "itl_p95_ms_churn_phase_sep": round(off["itl_p95"] * 1e3, 2),
+        "ttft_p95_ms": round(on["ttft_p95"] * 1e3, 2),
+        "ttft_p95_ms_phase_sep": round(off["ttft_p95"] * 1e3, 2),
+        "identical": on["streams"] == off["streams"],
+        "chunk_tokens": CHUNK,
+        "requests": TOTAL,
+    }}
+
+
 def measure_paged() -> dict:
     """Paged (block-pool) vs dense slot-cache DEVICE decode step rate
     (ISSUE 5 acceptance leg). Same discipline as
@@ -2899,6 +3039,7 @@ def bench_legs(line: dict):
         ("speculative", lambda: line.update(measure_speculative())),
         ("continuous", lambda: line.update(measure_continuous())),
         ("continuous_spec", lambda: line.update(measure_continuous_spec())),
+        ("chunked_prefill", lambda: line.update(measure_chunked_prefill())),
         ("paged_kv", lambda: line.update(measure_paged())),
         ("paged_tp", lambda: line.update(measure_paged_tp())),
         ("lookahead_overlap", lambda: line.update(measure_lookahead_overlap())),

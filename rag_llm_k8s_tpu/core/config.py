@@ -619,6 +619,35 @@ class EngineConfig:
     # output starts quoting again), above it K scales with the EMA.
     # Env: TPU_RAG_SPEC_PAGED_MIN_ACCEPT.
     spec_paged_min_accept: float = 0.3
+    # unified ragged sync windows for the PAGED CONTINUOUS engine
+    # (docs/KV_POOL.md "Unified ragged sync windows"; Sarathi/vLLM-style
+    # chunked prefill): every device step carries a token budget split
+    # between decode lanes and admission-prefill CHUNKS, so a long prompt
+    # prefills across N windows while decode never stops — TTFT under
+    # load stops being hostage to batch-mate prompt lengths, and the
+    # right-padded admission group's padding_bubble chip-time (measured
+    # by obs/goodput.py) is reclaimed as prefill compute. Greedy AND
+    # seeded streams stay byte-identical to the phase-separated
+    # scheduler (tests/test_chunked_prefill.py pins it, incl. chaos
+    # resets and tp=2). Requires kv_paged=True (validate_interleave).
+    # Off by default: the phase-separated admission path is untouched.
+    # Env: TPU_RAG_INTERLEAVE_PREFILL.
+    interleave_prefill: bool = False
+    # prefill tokens fed per row per mixed window (the static lane width
+    # of the mixed executable — one compile per value). Smaller chunks
+    # bound per-window decode stall tighter but pay more window
+    # overheads per prompt; 64 amortizes well at 1B-8B scale while
+    # keeping worst-case added inter-token latency ≈ one chunk forward.
+    # Env: TPU_RAG_PREFILL_CHUNK_TOKENS.
+    prefill_chunk_tokens: int = 64
+    # total token budget per mixed window, split decode-first: active
+    # decode lanes cost 1 each, the remainder is sliced into prefill
+    # chunks of ≤ prefill_chunk_tokens. 0 = auto (max_batch_size +
+    # prefill_chunk_tokens — every decode lane plus one full chunk).
+    # Nonzero values must leave room for at least one decode lane per
+    # row plus one prefill token (validate_interleave).
+    # Env: TPU_RAG_WINDOW_TOKEN_BUDGET.
+    window_token_budget: int = 0
     # cross-request KV prefix cache (see PrefixCacheConfig)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
 
@@ -643,6 +672,35 @@ class EngineConfig:
                 f"axis: num_kv_heads={num_kv_heads} must be divisible by "
                 f"tp — choose a tp that divides the head count, or serve "
                 "this model dense on the mesh"
+            )
+
+    def validate_interleave(self) -> None:
+        """Cross-field rules for unified ragged sync windows. Called from
+        ``from_env`` (with the env applied) and at continuous-engine
+        construction, so a bad pairing fails with the fix spelled out
+        instead of as a shape error mid-admission."""
+        if not self.interleave_prefill:
+            return
+        if not self.kv_paged:
+            raise ValueError(
+                "interleave_prefill=True requires kv_paged=True — chunked "
+                "prefill writes through block tables; set "
+                "TPU_RAG_KV_PAGED=1 or disable TPU_RAG_INTERLEAVE_PREFILL"
+            )
+        if self.prefill_chunk_tokens < 1:
+            raise ValueError(
+                f"prefill_chunk_tokens={self.prefill_chunk_tokens}: the "
+                "mixed window must carry at least one prefill token per "
+                "scheduled chunk"
+            )
+        if self.window_token_budget and (
+            self.window_token_budget < self.max_batch_size + 1
+        ):
+            raise ValueError(
+                f"window_token_budget={self.window_token_budget} cannot "
+                f"cover max_batch_size={self.max_batch_size} decode lanes "
+                "plus one prefill token — raise the budget or set 0 for "
+                "auto (max_batch_size + prefill_chunk_tokens)"
             )
 
 
@@ -1093,6 +1151,28 @@ class AppConfig:
                     "rate floor must lie in [0, 1]"
                 )
             engine = dataclasses.replace(engine, spec_paged_min_accept=ma)
+        if "TPU_RAG_INTERLEAVE_PREFILL" in env:
+            flag = env["TPU_RAG_INTERLEAVE_PREFILL"]
+            if flag not in ("0", "1"):
+                raise ValueError(
+                    f"TPU_RAG_INTERLEAVE_PREFILL={flag!r}: expected '0' or '1'"
+                )
+            engine = dataclasses.replace(engine, interleave_prefill=flag == "1")
+        if "TPU_RAG_PREFILL_CHUNK_TOKENS" in env:
+            ct = int(env["TPU_RAG_PREFILL_CHUNK_TOKENS"])
+            if ct < 1:
+                raise ValueError(
+                    f"TPU_RAG_PREFILL_CHUNK_TOKENS={ct}: expected >= 1"
+                )
+            engine = dataclasses.replace(engine, prefill_chunk_tokens=ct)
+        if "TPU_RAG_WINDOW_TOKEN_BUDGET" in env:
+            wb = int(env["TPU_RAG_WINDOW_TOKEN_BUDGET"])
+            if wb < 0:
+                raise ValueError(
+                    f"TPU_RAG_WINDOW_TOKEN_BUDGET={wb}: expected >= 0 "
+                    "(0 = auto)"
+                )
+            engine = dataclasses.replace(engine, window_token_budget=wb)
         if "TPU_RAG_WARM_FULL_LADDER" in env:
             flag = env["TPU_RAG_WARM_FULL_LADDER"]
             if flag not in ("0", "1"):
@@ -1249,6 +1329,7 @@ class AppConfig:
             )
         goodput.validate()  # range rules once, with the env applied
         engine = dataclasses.replace(engine, goodput=goodput)
+        engine.validate_interleave()  # cross-field rules, with the env applied
         resilience = cfg.resilience
 
         def _res_int(var: str, field_name: str, minimum: int):

@@ -120,6 +120,11 @@ class _Slot:
     # adaptive draft length (None = no evidence yet; engine/speculative.py)
     history: List[int] = field(default_factory=list)
     spec_ema: Optional[float] = None
+    # interleaved chunked prefill (interleave_prefill): the slot is RESERVED
+    # for an in-flight chunked admission — not yet decoding (active=False on
+    # host AND device), but not free either. The admission record itself
+    # lives in ``_chunk_admissions``; the flag keeps ``free_slots`` honest.
+    prefilling: bool = False
 
 
 class ContinuousEngine:
@@ -283,6 +288,34 @@ class ContinuousEngine:
                     f"spec_paged_min_accept={self.spec_min_accept}: an "
                     "acceptance-RATE floor must lie in [0, 1]"
                 )
+        # ---- unified ragged sync windows (chunked prefill; ISSUE 16) ----
+        # With interleave_prefill on, admission no longer prefills in one
+        # phase-separated shot: admit_many RESERVES a row and queues a
+        # chunked-admission record, and each mixed window feeds a budgeted
+        # slice of pending prompts alongside every active decode lane
+        # through ONE chunked forward (paged_chunk_attention's third
+        # consumer, after prefix splicing and speculative verify). Streams
+        # stay byte-identical to the phase-separated scheduler because
+        # sampling is (seed, position)-keyed: the first token of a prompt
+        # of length P folds fold_in(row_key, P) on its FINAL chunk exactly
+        # as the one-shot admission does, and decode lanes fold wi+1
+        # exactly as step_paged does — window shape cannot change draws.
+        self.interleave_on = bool(
+            getattr(engine_config, "interleave_prefill", False)
+        )
+        # in-flight chunked admissions, admission order (= scheduling
+        # order; FIFO keeps TTFT fair). rid -> dict with the reserved row,
+        # truncated prompt, progress frontier, UNFOLDED row key, decode
+        # budget, admission stamps. Initialized unconditionally: reset(),
+        # evict_requests and the planner touch it without re-checking the
+        # knob.
+        self._chunk_admissions: "OrderedDict[int, dict]" = OrderedDict()
+        if self.interleave_on:
+            engine_config.validate_interleave()  # requires kv_paged, ranges
+            self.chunk_tokens = int(engine_config.prefill_chunk_tokens)
+            self.window_budget = int(engine_config.window_token_budget) or (
+                self.B + self.chunk_tokens
+            )
         # ---- goodput ledger (obs/goodput.py; ISSUE 14) ------------------
         # every device sync window — admission prefills, decode windows,
         # verify windows — is attributed into the closed category set with
@@ -467,6 +500,10 @@ class ContinuousEngine:
             # speculation (windows where no row drafts fall back), so warm
             # both — the first quoting answer must not pay a compile
             self._get("verify_paged", self.spec_K)
+        if self.interleave_on:
+            # the mixed decode+chunk window — the first interleaved
+            # admission must not pay a compile either
+            self._get("mixed_step", self.chunk_tokens)
 
     def _put(self, x, sharding=None):
         """Place a host/device value to match a lowered aval's sharding;
@@ -540,6 +577,11 @@ class ContinuousEngine:
             # a stale record would double-submit it (duplicate tokens at the
             # stream head + a full duplicate decode)
             self._preempted.clear()
+            # same story for in-flight chunked admissions: their blocks went
+            # back with kv_pool.reset(), and the reset recovery resubmits
+            # the requests — a stale record would re-prefill into a row the
+            # resubmission also claims
+            self._chunk_admissions.clear()
 
     # ------------------------------------------------------------------
     # executables
@@ -571,6 +613,8 @@ class ContinuousEngine:
                 fn = self._build_boundary_px_paged(S)  # S carries the window
             elif kind == "verify_paged":
                 fn = self._build_verify_paged(S)  # S carries the draft count K
+            elif kind == "mixed_step":
+                fn = self._build_mixed_step(S)  # S carries the chunk width
             else:
                 fn = self._build_insert(S, n)
             self._m_compile_events.inc()
@@ -1631,6 +1675,127 @@ class ContinuousEngine:
             jax.ShapeDtypeStruct((B,), i32, sharding=rep),
         ).compile()
 
+    def _build_mixed_step(self, C: int):
+        """The MIXED decode+chunk window executable (ISSUE 16): one device
+        call advances every active decode lane by one token AND feeds each
+        scheduled admission a ``<= C``-token slice of its prompt through
+        the paged chunked model — ``paged_chunk_attention``'s third
+        consumer, after prefix splicing and speculative verify. Lane
+        width ``C`` is static (one compile per chunk size); rows declare
+        their role per window with host-fed vectors:
+
+        - decode rows (``active`` & ``n_fed == 0``): lane 0 carries the
+          device-resident ``last_tok`` at position ``kv_len`` — exactly
+          the plain step's write/attend/sample, with ``C - 1`` junk lanes
+          beyond the frontier (verify's masking discipline);
+        - chunk rows (``n_fed > 0``): lanes ``0..n_fed-1`` carry prompt
+          tokens at canonical positions ``chunk_base + j`` (``chunk_base``
+          is HOST-fed — a never-inserted prefilling row's device
+          ``kv_len`` is junk), written through the row's block table with
+          offset causality. The FINAL chunk additionally samples the
+          first token from lane ``n_fed - 1``'s plane;
+        - everything else parks wholesale at the NULL block.
+
+        Byte-identity falls out of the (seed, position) key discipline:
+        every row folds ``fold_in(row_key, base + n_eff)`` — a decode row
+        folds ``wi + 1`` exactly like ``_build_step_paged``, and a final
+        chunk folds ``fold_in(row_key, prompt_len)`` exactly like the
+        one-shot admission — so the window's shape cannot change any
+        draw, and chunked prompt KV bit-equals one-shot prefill KV (same
+        canonical positions, same kernel)."""
+        cfg, dt, sampling = self.config, self.dtypes, self.sampling
+        model = self.model_chunked_paged
+        eos_ids = cfg.eos_token_ids
+        B = self.B
+        Tmax = self.MB * self.block_size
+        kv_quant = self.kv_quant
+        i32 = jnp.int32
+        from rag_llm_k8s_tpu.models.llama import KVCache
+
+        def mixed(params, cache_t, tables, kv_len, last_tok, active,
+                  rng_keys, fed, n_fed, chunk_base, final):
+            is_chunk = n_fed > 0
+            is_dec = active & ~is_chunk
+            # tokens each row really feeds this window: 1 for decode
+            # lanes, the slice width for chunk rows, 0 for bystanders
+            n_eff = jnp.where(is_dec, 1, n_fed)
+            part = n_eff > 0
+            # decode rows anchor at the device frontier; chunk rows at the
+            # host-tracked progress frontier (their device kv_len is junk
+            # until the final chunk lands)
+            base = jnp.where(
+                is_chunk, chunk_base, jnp.where(active, kv_len, 0)
+            )
+            # decode rows' lane 0 is the device-resident last_tok — the
+            # host never fetches it between windows (same reason the
+            # plain step keeps it on device)
+            lane0 = jnp.arange(C, dtype=i32)[None, :] == 0
+            fed_eff = jnp.where(is_dec[:, None] & lane0, last_tok[:, None], fed)
+            # bystanders' junk routes to the NULL block (same rule as the
+            # plain step: an EOS'd row's table is still mapped until the
+            # host drains, and logical block 0 can be ref-shared)
+            tables_eff = jnp.where(part[:, None], tables, NULL_BLOCK)
+            pos = base[:, None] + jnp.arange(C, dtype=i32)[None, :]  # [B, C]
+            # the deepest REAL lane (j = n_eff - 1) attends keys
+            # <= base + n_eff - 1: kv_len = base + n_eff caps every row's
+            # window there; junk lanes beyond see truncated windows and
+            # junk logits nobody samples from
+            logits, cache = model.apply(
+                {"params": params}, fed_eff, pos, KVCache(*cache_t),
+                jnp.zeros((B,), i32), base + n_eff, base,
+                block_tables=tables_eff,
+            )
+            # each row samples from its last REAL lane's plane: plane 0
+            # for decode (= the plain step's logits[:, 0]), plane
+            # n_fed - 1 for a final chunk (= the one-shot admission's
+            # logit_index = prompt_len - 1 plane)
+            sel = jnp.take_along_axis(
+                logits, jnp.maximum(n_eff - 1, 0)[:, None, None], axis=1
+            )[:, 0]
+            keys = jax.vmap(jax.random.fold_in)(rng_keys, base + n_eff)
+            tok = sample_token_per_row(keys, sel, sampling)
+            hit_eos = _isin(tok, eos_ids)
+            # frontier: base + n_eff KV positions are now written — wi + 1
+            # for decode (the plain step's update), prompt progress for
+            # chunk rows (the final chunk lands kv_len = prompt_len, the
+            # exact post-admission invariant: tok0's KV writes next window)
+            kv_len = jnp.where(
+                part, jnp.minimum(base + n_eff, Tmax - 1), kv_len
+            )
+            last_tok = jnp.where(is_dec | final, tok, last_tok)
+            # final chunks activate their row (admission complete); decode
+            # rows stay active; both retire on EOS. Mid-prompt chunk rows
+            # stay device-inactive until their final chunk.
+            active = (active | final) & ~hit_eos
+            out = (
+                (cache.k, cache.v, cache.k_scale, cache.v_scale)
+                if kv_quant == "int8" else (cache.k, cache.v)
+            )
+            return out, kv_len, last_tok, tok, hit_eos, active
+
+        rep = self.mesh.replicated if self.mesh is not None else None
+        out_shardings = (
+            (self._arena_shardings(), rep, rep, rep, rep, rep)
+            if self.mesh is not None else None
+        )
+        # tables/rng_keys/fed/n_fed/chunk_base/final are host-fed per
+        # window, never donated
+        return jax.jit(
+            mixed, donate_argnums=(1, 3, 4, 5), out_shardings=out_shardings
+        ).lower(
+            param_avals(self.params),
+            self._arena_avals(),
+            jax.ShapeDtypeStruct((B, self.MB), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), bool, sharding=rep),
+            jax.ShapeDtypeStruct((B, 2), jnp.uint32, sharding=rep),
+            jax.ShapeDtypeStruct((B, C), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+            jax.ShapeDtypeStruct((B,), bool, sharding=rep),
+        ).compile()
+
     def _build_prefix_scatter(self, P: int):
         """Scatter a ``CachedPrefix``'s splice-buffer planes into pool
         blocks: a static loop over the buffer's ``P // block_size`` slabs,
@@ -2078,6 +2243,13 @@ class ContinuousEngine:
         need = self.blocks_needed(prompt_len)
         if need > self.kv_pool.usable_blocks():
             return "never"
+        if self.interleave_on:
+            # incremental admission: blocks are allocated per CHUNK by the
+            # window planner (which reclaims re-buildable registrations
+            # under pressure and idles/preempts pending admissions last) —
+            # a free row is the only up-front gate, and the scheduler
+            # checks that separately
+            return "ok"
         # +1 headroom: the first decode window must be able to open the
         # next block, or admission instantly preempts what it just
         # admitted. Capped at MB — a row's lifetime growth never exceeds
@@ -2191,6 +2363,15 @@ class ContinuousEngine:
                 )
                 self._drop_registration(victim)
                 continue
+            if self._chunk_admissions:
+                # pending chunked admissions are the cheapest preemption
+                # victims: ZERO emitted tokens to replay — the scheduler
+                # resubmits them wholesale, and recompute is exactly the
+                # prefill that hadn't happened yet. Newest-queued first,
+                # matching the active-row discipline below.
+                rid, rec = self._chunk_admissions.popitem()
+                self._preempt_chunk_admission(rid, rec)
+                continue
             victims = [
                 (s.admit_seq, r) for r, s in enumerate(self.slots) if s.active
             ]
@@ -2214,6 +2395,28 @@ class ContinuousEngine:
             self._active = self._active & self._put(jnp.asarray(m))
             self._release_row(victim)
             self.slots[victim] = _Slot()
+
+    def _preempt_chunk_admission(self, rid: int, rec: dict) -> None:
+        """Cancel an in-flight chunked admission under pool pressure: its
+        partially-written blocks return to the pool and the request joins
+        the preempted list with ZERO emitted tokens — the scheduler
+        resubmits it (``_fold_emitted`` no-ops on the empty record), so
+        the only cost is re-prefilling what this row had staged."""
+        row = rec["row"]
+        logger.warning(
+            "kv pool exhausted; preempting chunked admission %d "
+            "(%d blocks back to the pool, %d/%d prompt tokens staged)",
+            rid, len(self._slot_blocks[row]), rec["progress"],
+            len(rec["prompt"]),
+        )
+        self._preempted.append((rid, []))
+        self._m_pool_preempt.inc()
+        flight.emit(
+            "preempt", rid,
+            blocks=len(self._slot_blocks[row]), n_tokens=0,
+        )
+        self._release_row(row)
+        self.slots[row] = _Slot()
 
     def drain_preempted(self) -> List[Tuple[int, List[int]]]:
         """Requests preempted by pool exhaustion since the last drain, as
@@ -2247,10 +2450,18 @@ class ContinuousEngine:
     # operations (called by the scheduler thread only)
     # ------------------------------------------------------------------
     def free_slots(self) -> List[int]:
-        return [i for i, s in enumerate(self.slots) if not s.active]
+        # a prefilling row is reserved by an in-flight chunked admission:
+        # not decoding yet, but not admissible either
+        return [
+            i for i, s in enumerate(self.slots)
+            if not s.active and not s.prefilling
+        ]
 
     def has_active(self) -> bool:
-        return any(s.active for s in self.slots)
+        # prefilling rows count: the scheduler must keep stepping (mixed
+        # windows are what advances them), and admission_state must say
+        # "wait", not "never", while they hold pool blocks
+        return any(s.active or s.prefilling for s in self.slots)
 
     def evict_requests(self, request_ids: Sequence[int]) -> List[int]:
         """Deactivate the slots serving ``request_ids`` (deadline eviction):
@@ -2275,6 +2486,17 @@ class ContinuousEngine:
             self._retire_rows(rows)  # paged: blocks back to the free list
             for r in rows:
                 self.slots[r] = _Slot()
+        # in-flight chunked admissions are evictable too — the deadline
+        # sweep sees them in `waiting` like any decoding request, and their
+        # partially-written blocks must go back or eviction leaks the pool
+        for rid in [r for r in self._chunk_admissions if r in wanted]:
+            rec = self._chunk_admissions.pop(rid)
+            row = rec["row"]
+            flight.emit("evict", rid, n_tokens=0)
+            self._blocks_at_retire[rid] = len(self._slot_blocks[row])
+            self._release_row(row)
+            self.slots[row] = _Slot()
+            rows.append(row)
         return rows
 
     def admit(
@@ -2339,6 +2561,24 @@ class ContinuousEngine:
             else:
                 self._rng, row_key = jax.random.split(self._rng)
             prepared.append((i, rid, S, p, max_new_c, row_key))
+
+        if self.interleave_on and self.paged:
+            # unified ragged windows (ISSUE 16): admission is INCREMENTAL —
+            # reserve a row and queue the prompt; mixed windows feed it in
+            # budgeted chunks alongside decode. No prefill forward, no
+            # up-front block allocation (the planner allocates per chunk),
+            # so this path cannot raise PoolExhausted. The prep above ran
+            # UNCHANGED — same bucketing/truncation/clamp and the same
+            # ``self._rng`` split order, so streams bit-match the
+            # phase-separated scheduler.
+            results = [None] * len(items)
+            free_iter = iter(free)
+            for i, rid, S, p, max_new_c, row_key in prepared:
+                self._queue_chunk_admission(
+                    i, rid, S, p, max_new_c, row_key,
+                    next(free_iter), results,
+                )
+            return results
 
         by_bucket: Dict[int, List] = {}
         for entry in prepared:
@@ -2596,6 +2836,253 @@ class ContinuousEngine:
                 self.slots[row] = _Slot()
             raise
 
+    def _queue_chunk_admission(
+        self, i: int, rid: int, S: int, p: List[int], max_new_c: int,
+        row_key, row: int, results: List,
+    ) -> None:
+        """Reserve ``row`` for an incremental admission and queue its
+        record — zero device work. The row's UNFOLDED key is staged now
+        (the ``insert_paged`` idiom): the final chunk's executable folds
+        ``(row_key, len(p))`` from it, and decode continues the same fold
+        sequence once the row activates."""
+        self._admit_seq += 1
+        self._rng_keys = self._rng_keys.at[row].set(self._put(row_key))
+        self.slots[row] = _Slot(
+            request_id=rid, prefilling=True, admit_seq=self._admit_seq,
+            prompt_len=len(p),
+        )
+        self._chunk_admissions[rid] = {
+            "row": row, "prompt": p, "progress": 0, "row_key": row_key,
+            "max_new": max_new_c, "bucket": S, "admit_seq": self._admit_seq,
+            # TTFT anchors: the scheduler overwrites t_submit with the
+            # request's real submit stamp (or None for retries/resumes,
+            # which never observe TTFT — phase-separated parity); raw
+            # engine callers fall back to the queue stamp
+            "t_admit": time.monotonic(),
+        }
+        results[i] = (row, None)
+
+    def _alloc_chunk_blocks(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` blocks for a scheduled prefill chunk, reclaiming
+        re-buildable registrations under pressure in ``admission_state``'s
+        order: chunk-canonical copies first, then non-hot prefix chains,
+        then — only when nothing decodes — hot chains. ``None`` = the pool
+        really is full; the planner idles the admission this window."""
+        while True:
+            try:
+                return self.kv_pool.alloc(n)
+            except PoolExhausted:
+                if self._chunk_regs:
+                    self._drop_chunk_reg(next(iter(self._chunk_regs)))
+                    continue
+                non_hot = [
+                    k for k, t in list(self._prefix_tier.items())
+                    if t != "hot"
+                ]
+                if non_hot:
+                    self._drop_registration(non_hot[0])
+                    continue
+                if self._prefix_blocks and not self.has_active():
+                    self._drop_registration(next(iter(self._prefix_blocks)))
+                    continue
+                return None
+
+    def _step_mixed(self) -> List[Tuple[int, List[int]]]:
+        """One UNIFIED ragged sync window (ISSUE 16): every active decode
+        lane advances one token while a budgeted slice of each pending
+        chunked admission prefills through the SAME device call — decode
+        never stops for admission, and a long prompt spreads its prefill
+        across as many windows as its chunks.
+
+        Budget split: active decode lanes cost one token each; the
+        remainder slices pending admissions FIFO (oldest first — the
+        request closest to its first token wins the window's leftover).
+        Each scheduled chunk allocates only ITS blocks (incremental — the
+        one-shot path pays the whole prompt up front); pool pressure
+        reclaims re-buildable registrations, then idles the youngest
+        admissions for the window.
+
+        The drain mirrors the two phase-separated paths exactly: decode
+        rows drain like a ``k=1`` plain window, final chunks run
+        ``_admit_chunk_paged``'s tail (admit event, EOS/max_new<=1
+        immediate retire, fresh active ``_Slot`` otherwise) — so streams,
+        events and block accounting are indistinguishable downstream."""
+        C = self.chunk_tokens
+        t_w = time.perf_counter()  # ledger window: planning + growth included
+        Tmax = self.MB * self.block_size
+        # map decode lanes' one write each BEFORE dispatch; exhaustion here
+        # preempts pending chunked admissions before any decoding row
+        self._ensure_decode_blocks(horizon={})
+        n_dec = sum(1 for s in self.slots if s.active)
+        remaining = max(0, self.window_budget - n_dec)
+        sched = []  # (rid, rec, offset, take, final)
+        blocked = False
+        for rid, rec in list(self._chunk_admissions.items()):
+            if remaining <= 0 or blocked:
+                break
+            row = rec["row"]
+            left = len(rec["prompt"]) - rec["progress"]
+            take = min(C, remaining, left)
+            if take <= 0:
+                continue
+            need = self.kv_pool.blocks_for(rec["progress"] + take)
+            have = len(self._slot_blocks[row])
+            if need > have:
+                ids = self._alloc_chunk_blocks(need - have)
+                if ids is None:
+                    blocked = True  # pool pressure: idle the rest this window
+                    break
+                self._assign_row_blocks(row, ids, start_block=have)
+            final = rec["progress"] + take >= len(rec["prompt"])
+            sched.append((rid, rec, rec["progress"], take, final))
+            remaining -= take
+        flight.emit(
+            "window_budget", budget=self.window_budget, decode_lanes=n_dec,
+            chunk_tokens=sum(t for _, _, _, t, _ in sched),
+            chunks=len(sched), queued=len(self._chunk_admissions),
+        )
+        for rid, rec, off, take, final in sched:
+            flight.emit(
+                "prefill_chunk_sched", rid, offset=off, tokens=take,
+                remaining=len(rec["prompt"]) - off - take, final=int(final),
+            )
+        if not sched and n_dec == 0:
+            # the pool can't stage even the oldest admission and nothing
+            # decodes: make room by preempting the newest (the scheduler
+            # resubmits; the admission_state gate re-screens impossible
+            # prompts) instead of spinning an empty window
+            if self._chunk_admissions:
+                vrid, vrec = self._chunk_admissions.popitem()
+                self._preempt_chunk_admission(vrid, vrec)
+            self._journal_window(self.ledger.record_preempt_stall(
+                time.perf_counter() - t_w,
+                [r for r, _ in self._preempted], kind="prefill",
+            ))
+            return []
+        flight.emit(
+            "sync_window_open", steps=1, active=n_dec + len(sched),
+        )
+        fed = np.full((self.B, C), self.pad_id, np.int32)
+        n_fed = np.zeros((self.B,), np.int32)
+        chunk_base = np.zeros((self.B,), np.int32)
+        final_v = np.zeros((self.B,), bool)
+        for rid, rec, off, take, final in sched:
+            row = rec["row"]
+            fed[row, :take] = rec["prompt"][off : off + take]
+            n_fed[row] = take
+            chunk_base[row] = off
+            final_v[row] = final
+        # context tokens resident at dispatch: decode rows' frontiers plus
+        # each chunk's attended prefix (its own slice included)
+        ctx = sum(s.kv_ub for s in self.slots if s.active) + sum(
+            off + take for _, _, off, take, _ in sched
+        )
+        t0 = time.perf_counter()
+        (self._cache, self._kv_len, self._last_tok, toks, eoss,
+         self._active) = self._get("mixed_step", C)(
+            self.params, self._cache, self._device_tables(),
+            self._kv_len, self._last_tok, self._active, self._rng_keys,
+            self._put(jnp.asarray(fed)), self._put(jnp.asarray(n_fed)),
+            self._put(jnp.asarray(chunk_base)),
+            self._put(jnp.asarray(final_v)),
+        )
+        self.steps += 1
+        tok_h = np.asarray(toks)  # [B] — ONE fetch for decode AND admissions
+        t_fetch = time.perf_counter()
+        self._m_itl.observe(t_fetch - t0)
+        self._m_step_device.observe(t_fetch - t0)
+        eos_h = np.asarray(eoss)
+        for slot in self.slots:
+            if slot.active:
+                slot.kv_ub = min(slot.kv_ub + 1, Tmax - 1)
+        done: List[Tuple[int, List[int]]] = []
+        deactivate = []
+        kept: Dict[int, int] = {}  # rid -> decode tokens kept (ledger)
+        # ---- decode lanes: exactly a k=1 plain-window drain --------------
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            finished = False
+            kept[slot.request_id] = 0
+            if eos_h[i]:
+                finished = True  # EOS token itself is not emitted
+            else:
+                slot.tokens.append(int(tok_h[i]))
+                if self.spec_on:
+                    slot.history.append(int(tok_h[i]))
+                slot.remaining -= 1
+                self.stats.decode_tokens += 1
+                kept[slot.request_id] += 1
+                if slot.remaining <= 0:
+                    finished = True
+            if finished:
+                done.append((slot.request_id, slot.tokens))
+                flight.emit(
+                    "eos", slot.request_id,
+                    reason="budget" if slot.remaining <= 0 else "eos",
+                    n_tokens=len(slot.tokens),
+                )
+                slot.active = False
+                deactivate.append(i)
+        # ---- chunk rows: progress, and _admit_chunk_paged's tail on the
+        # final chunk --------------------------------------------------
+        chunk_led: Dict[int, int] = {}  # rid -> real prefill lanes (ledger)
+        finished_rows: List[int] = []
+        for rid, rec, off, take, final in sched:
+            row = rec["row"]
+            rec["progress"] = off + take
+            chunk_led[rid] = take
+            if not final:
+                continue
+            tok0 = int(tok_h[row])
+            p = rec["prompt"]
+            max_new_c = rec["max_new"]
+            del self._chunk_admissions[rid]
+            self.stats.generate_calls += 1
+            self.stats.prefill_tokens += len(p)
+            flight.emit(
+                "admit", rid, slot=row, prompt_len=len(p),
+                bucket=rec["bucket"], tok0=tok0,
+            )
+            ts = rec.get("t_submit", rec["t_admit"])
+            if ts is not None:
+                self._m_ttft.observe(time.monotonic() - ts)
+            if tok0 in self.config.eos_token_ids or max_new_c <= 1:
+                out = [] if tok0 in self.config.eos_token_ids else [tok0]
+                self.stats.decode_tokens += len(out)
+                done.append((rid, out))
+                # the executable left an EOS'd final inactive; the budget
+                # case it activated — mask either way, and retire via the
+                # common tail (the slot still carries rid for the footprint)
+                deactivate.append(row)
+                finished_rows.append(row)
+                continue
+            self.slots[row] = _Slot(
+                request_id=rid, tokens=[tok0], remaining=max_new_c - 1,
+                active=True, kv_ub=len(p), admit_seq=rec["admit_seq"],
+                prompt_len=len(p),
+                history=(list(p) + [tok0]) if self.spec_on else [],
+            )
+            self.stats.decode_tokens += 1
+        if deactivate:
+            mask = np.ones(self.B, bool)
+            mask[deactivate] = False
+            self._active = self._active & self._put(jnp.asarray(mask))
+            self._retire_rows(deactivate)  # blocks back + footprint record
+        for row in finished_rows:
+            self.slots[row] = _Slot()  # clear the prefilling reservation
+        self._m_step_drain.observe(time.perf_counter() - t_fetch)
+        self._journal_window(self.ledger.record_mixed(
+            time.perf_counter() - t_w, batch=self.B, lanes=C,
+            decode_kept=kept, chunk_rows=chunk_led,
+            rework=self._take_rework(chunk_led), ctx_tokens=ctx,
+        ))
+        flight.emit(
+            "sync_window_close", steps=1, done=len(done),
+            duration_ms=round((time.perf_counter() - t0) * 1e3, 3),
+        )
+        return done
+
     def step(self) -> List[Tuple[int, List[int]]]:
         """``decode_sync_steps`` decode steps for every active slot in one
         device call + one host fetch. Returns completed requests as
@@ -2612,6 +3099,12 @@ class ContinuousEngine:
         (``_verify_worthwhile``). Windows that don't clear the bar (and
         all no-draft windows) keep the plain path untouched."""
         faults.maybe_fail("decode_step")
+        if self.interleave_on and self.paged and self._chunk_admissions:
+            # unified ragged window: pending chunked admissions ride along
+            # with decode; speculation resumes once the queue drains (both
+            # window shapes are draw-invariant, so streams never notice
+            # the handoff)
+            return self._step_mixed()
         if self.spec_on and self.paged:
             drafts = self._draft_for_slots()
             if any(drafts.values()) and self._verify_worthwhile(drafts):
@@ -3217,7 +3710,18 @@ class ContinuousScheduler:
                         # double-count it and fold the reset backoff into
                         # the histogram the SLO layer alerts on (same for a
                         # pool-preemption resume)
-                        if not b.retried and not b.resumed:
+                        chunk_rec = eng._chunk_admissions.get(b.request_id)
+                        if chunk_rec is not None:
+                            # interleaved admission: no first token yet —
+                            # hand the engine the real submit stamp so the
+                            # mixed window that samples tok0 observes the
+                            # exact TTFT (None keeps the retry/resume
+                            # no-double-count rule above)
+                            chunk_rec["t_submit"] = (
+                                b.t_submit
+                                if not b.retried and not b.resumed else None
+                            )
+                        elif not b.retried and not b.resumed:
                             eng._m_ttft.observe(time.monotonic() - b.t_submit)
                         if finished is not None:
                             self._deliver(b, finished)

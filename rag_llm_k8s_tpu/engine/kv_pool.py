@@ -104,7 +104,11 @@ class KVBlockPool:
 
     # -- capacity -------------------------------------------------------
     def blocks_for(self, tokens: int) -> int:
-        """Blocks needed to cover ``tokens`` logical positions."""
+        """Blocks needed to cover ``tokens`` logical positions. Also the
+        incremental-admission arithmetic: a chunked prefill (ISSUE 16)
+        grows a row per scheduled chunk by
+        ``blocks_for(progress + chunk) - blocks_for(progress)`` instead of
+        paying the whole prompt's allocation up front."""
         return max(0, -(-int(tokens) // self.block_size))
 
     def available(self) -> int:

@@ -728,7 +728,9 @@ class Attention(nn.Module):
             # — clipping into logical block MB-1 would overwrite valid KV
             # at the top of the slot ladder (a speculative verify window's
             # junk lanes can run past a row's last logical block; so could
-            # any chunked write near the window end)
+            # any chunked write near the window end, and a mixed ragged
+            # window's decode rows carry chunk_width-1 junk lanes past
+            # their frontier every step)
             phys = jnp.where(blk_raw < MB, phys, 0)
             off = pos % bs_len
             flat_phys = phys.reshape(-1)  # [B*S]

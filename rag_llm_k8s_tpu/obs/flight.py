@@ -100,6 +100,12 @@ EVENTS: Dict[str, str] = {
                       "conservation invariant), tokens, per-window "
                       "mfu/bw/bound — flightview --goodput rebuilds the "
                       "/debug/goodput report from these offline",
+    "window_budget": "a unified ragged sync window split its token budget "
+                     "(budget, decode_lanes, chunk_tokens scheduled, "
+                     "chunks, queued admissions still pending)",
+    "prefill_chunk_sched": "the window planner scheduled one admission's "
+                           "prefill chunk (offset into the prompt, tokens "
+                           "fed, remaining after, final=1 samples tok0)",
     # -- KV block pool (engine/kv_pool.py) -------------------------------
     "pool_alloc": "physical KV blocks taken from the pool (blocks, free "
                   "remaining)",

@@ -87,7 +87,7 @@ CATEGORIES = (
 WINDOW_CATEGORIES = CATEGORIES[:-1]
 
 #: Executable kinds the ledger aggregates roofline figures per.
-KINDS = ("prefill", "prefill_px", "decode", "verify", "oneshot")
+KINDS = ("prefill", "prefill_px", "decode", "verify", "oneshot", "mixed")
 
 #: Generic single-chip peaks used when the config does not pin them
 #: (TPU_RAG_GOODPUT_PEAK_TFLOPS / TPU_RAG_GOODPUT_HBM_GBS): a TPU-v4-class
@@ -597,6 +597,63 @@ class GoodputLedger:
         )
         return summary
 
+    def record_mixed(
+        self,
+        dur_s: float,
+        batch: int,
+        lanes: int,
+        decode_kept: Dict[int, int],  # rid -> decode tokens the drain kept
+        chunk_rows: Dict[int, int],  # rid -> prefill tokens fed this window
+        rework: Optional[Set[int]] = None,
+        ctx_tokens: int = 0,
+    ) -> Optional[Dict]:
+        """One UNIFIED ragged sync window (ISSUE 16): ``batch × lanes``
+        lane grid, where each active decode row used exactly one real lane
+        and each scheduled admission used its chunk's ``chunk_rows[rid]``
+        lanes. Decode lanes that kept their token are ``decode_useful``;
+        chunked-prefill lanes are ``prefill_compute`` — the whole point of
+        the mixed window is that these lanes STOP being the
+        ``padding_bubble`` the phase-separated scheduler burned — unless
+        the admission is a preemption/reset resubmission
+        (``preempt_rework``, attributed exactly once, same rule as
+        ``record_prefill``). Everything else in the grid is bubble.
+        Conservation is exact by ``_split``; only decode tokens feed the
+        useful-decode throughput figure (prompt tokens never did)."""
+        if not self.enabled or dur_s <= 0:
+            return None
+        rework = rework or set()
+        grid = max(1, batch * max(1, lanes))
+        useful = sum(decode_kept.values())
+        computed = sum(
+            n for rid, n in chunk_rows.items() if rid not in rework
+        )
+        refed = sum(n for rid, n in chunk_rows.items() if rid in rework)
+        cat_s, total = self._split(dur_s, {
+            "decode_useful": float(useful),
+            "prefill_compute": float(computed),
+            "preempt_rework": float(refed),
+            "padding_bubble": float(grid - useful - computed - refed),
+        })
+        rf = self.roofline
+        flops = rf.flops_per_token * (useful + computed + refed)
+        nbytes = rf.weight_bytes + ctx_tokens * rf.kv_bytes_per_token
+        with self._lock:
+            self._useful_decode_tokens += useful
+        per_request = {rid: float(n) for rid, n in decode_kept.items()}
+        for rid, n in chunk_rows.items():
+            per_request[rid] = per_request.get(rid, 0.0) + (
+                0.0 if rid in rework else float(n)
+            )
+        summary = self._apply(
+            "mixed", dur_s, cat_s, per_request, total,
+            flops, nbytes, float(useful + computed + refed),
+        )
+        # the decode share alone (record_oneshot's convention), so the
+        # offline reconstruction counts the same useful-decode-token total
+        # the live ledger does
+        summary["decode_tokens"] = int(useful)
+        return summary
+
     # ------------------------------------------------------------------
     # per-request attribution (engine/scheduler thread)
     # ------------------------------------------------------------------
@@ -728,7 +785,9 @@ def state_from_events(events: Sequence[Dict]) -> Dict:
             ks["bound"] = e["bound"]
         if kind in ("decode", "verify"):
             st["useful_decode_tokens"] += float(e.get("tokens", 0.0))
-        elif kind == "oneshot":
+        elif kind in ("oneshot", "mixed"):
+            # both carry prefill AND decode lanes in one window; the
+            # summary stamps the decode share separately
             st["useful_decode_tokens"] += float(e.get("decode_tokens", 0.0))
     if t_lo is not None:
         st["wall_s"] = max(st["busy_s"], float(t_hi) - float(t_lo))

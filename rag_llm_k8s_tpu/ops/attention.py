@@ -1430,7 +1430,15 @@ def paged_chunk_attention(
     window streams each row's live blocks ONCE for K+1 query lanes —
     decode is bandwidth-bound, which is exactly why a K+1-wide verify
     costs ~one decode step. Junk lanes past a row's real draft count are
-    masked by its ``kv_len`` window, never by extra kernel logic."""
+    masked by its ``kv_len`` window, never by extra kernel logic.
+
+    Its third consumer is the UNIFIED ragged sync window
+    (``ContinuousEngine._build_mixed_step``, ISSUE 16): decode lanes
+    (write_index = the row's frontier, one real query) and
+    chunked-prefill lanes (write_index = the admission's progress
+    offset, up to S real queries) ride the SAME S-wide call — the
+    per-row ``write_index``/``kv_len`` vectors are what lets rows play
+    different roles in one grid, with no new kernel logic."""
     B, S, H, hd = q.shape
     L, N, K, bs, _ = k_arena.shape
     G = H // K

@@ -78,6 +78,11 @@ def _engine_mode(scheduler) -> str:
     from rag_llm_k8s_tpu.engine.continuous import ContinuousScheduler
 
     if isinstance(scheduler, ContinuousScheduler):
+        # interleaved chunked prefill changes the serving shape enough
+        # (mixed windows, incremental admission) that fleet dashboards
+        # segment it separately
+        if getattr(scheduler.engine, "interleave_on", False):
+            return "continuous-interleaved"
         return "continuous"
     from rag_llm_k8s_tpu.engine.batching import BatchScheduler
 

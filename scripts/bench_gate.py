@@ -81,6 +81,13 @@ REQUIRED_KEYS = (
     # acceptance ≤ 2%) — the auditor is ON by default, so its overhead
     # may never go unjudged in a bench round
     "shadow_overhead.overhead_frac",
+    # ISSUE 16: unified ragged sync windows — the padding-bubble share of
+    # busy chip time on the heavy-admission-churn workload with chunked
+    # prefill interleaved into decode (acceptance: lower than the
+    # phase-separated scheduler's; regression.classify tracks bubble_frac
+    # lower-is-better) — a silently dropped leg must fail the gate, not
+    # read as "admission-churn occupancy unjudged"
+    "chunked_prefill.bubble_frac",
 )
 
 

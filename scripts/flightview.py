@@ -119,10 +119,18 @@ def build_view(events: List[Dict],
     windows = [e for e in events if e["type"] == "sync_window_open"]
     active = [int(e.get("active", 0)) for e in windows]
     closes = [e for e in events if e["type"] == "sync_window_close"]
+    # unified ragged sync windows (ISSUE 16): every mixed window journals
+    # one window_budget (the planner's decode/prefill token split) and one
+    # prefill_chunk_sched per chunk it granted
+    budgets = [e for e in events if e["type"] == "window_budget"]
+    chunks = [e for e in events if e["type"] == "prefill_chunk_sched"]
     occupancy = {
         "windows": len(windows),
         "active_mean": round(sum(active) / len(active), 2) if active else 0.0,
         "active_max": max(active) if active else 0,
+        "mixed_windows": len(budgets),
+        "prefill_chunks": len(chunks),
+        "prefill_chunk_tokens": sum(int(e.get("tokens", 0)) for e in chunks),
         "rows_done": sum(int(e.get("done", 0)) for e in closes),
         "resets": sum(1 for e in events if e["type"] == "reset"),
         "preemptions": sum(1 for e in events if e["type"] == "preempt"),
@@ -168,6 +176,12 @@ def render_ascii(view: Dict, meta: Optional[Dict] = None) -> str:
         f"  windows={occ['windows']}  active mean={occ['active_mean']}"
         f" max={occ['active_max']}  rows done={occ['rows_done']}"
     )
+    if occ.get("mixed_windows"):
+        lines.append(
+            f"  mixed windows={occ['mixed_windows']}  prefill chunks="
+            f"{occ['prefill_chunks']}  chunk tokens="
+            f"{occ['prefill_chunk_tokens']}"
+        )
     lines.append(
         f"  resets={occ['resets']}  preemptions={occ['preemptions']}"
         f"  sheds={occ['sheds']}  deadline expiries="
