@@ -147,6 +147,19 @@ interleave-smoke:
 shadow-smoke:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_shadow.py::TestShadowSmoke -q -p no:cacheprovider
 
+# Journal-replay smoke (ISSUE 17, docs/REPLAY.md): record a live CPU run
+# under the lockstep driver, extract_trace the journal, and re-drive it —
+# the decision stream (admissions, windows, budgets, preemptions, resets)
+# must be IDENTICAL, including a chaos-reset recording (the fault harness
+# armed mid-decode) and the chunked-prefill planner; plus the pure-host
+# simulator, calibrated on the same recording, must land its busy
+# chip-time within the ±25% fidelity band. The full matrix (policy
+# arithmetic, trace generation, journal round-trip/forward-compat,
+# simulator speedup/preemption/oracle) lives in the rest of
+# tests/test_replay.py and runs under tier1.
+replay-smoke:
+	env TPU_RAG_FAULTS=1 JAX_PLATFORMS=cpu python -m pytest tests/test_replay.py::TestReplaySmoke -q -p no:cacheprovider
+
 # Perf regression gate (scripts/bench_gate.py): compare a fresh bench JSON
 # against a committed baseline with per-metric tolerance bands, direction
 # aware (latency up = bad, tok/s down = bad). Defaults to comparing the
@@ -208,7 +221,7 @@ check: test tpu-test bench
 # (validates the baseline + gate plumbing without running the bench — the
 # TPU-judged comparison is `make bench` followed by
 # `make bench-gate BENCH_CURRENT=...`).
-ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke interleave-smoke flight-smoke goodput-smoke shadow-smoke lint analyze
+ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke interleave-smoke flight-smoke goodput-smoke shadow-smoke replay-smoke lint analyze
 	python scripts/bench_gate.py --baseline $(BENCH_BASELINE) --dry-run
 
-.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke interleave-smoke flight-smoke goodput-smoke shadow-smoke ci lint analyze check validate-8b validate-70b
+.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke interleave-smoke flight-smoke goodput-smoke shadow-smoke replay-smoke ci lint analyze check validate-8b validate-70b

@@ -1445,6 +1445,118 @@ def measure_shadow_overhead() -> dict:
     }
 
 
+def measure_replay_fidelity() -> dict:
+    """Simulator fidelity (ISSUE 17 acceptance, docs/REPLAY.md): record a
+    live continuous-scheduler run under the lockstep driver, calibrate a
+    step model on that recording, simulate the SAME extracted trace, and
+    compare the simulator's predicted steps/s (and busy chip-time, and
+    attributed cost) against the measurement it was calibrated on.
+
+    ``steps_per_s_ratio`` is simulated-over-measured — 1.0 is perfect;
+    ``bench_gate`` holds it inside the ±25% band (0.75–1.25, direction:
+    band). ``sim_speedup_x`` is virtual-time over wall-time for the
+    simulation itself, gated ≥ 100× — the figure that makes trace-driven
+    capacity planning cheaper than re-running the fleet.
+    """
+    import jax
+
+    from rag_llm_k8s_tpu.core.config import (
+        DTypePolicy,
+        EngineConfig,
+        LlamaConfig,
+        SamplingConfig,
+    )
+    from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine
+    from rag_llm_k8s_tpu.models.llama import init_llama_params
+    from rag_llm_k8s_tpu.obs import flight
+    from rag_llm_k8s_tpu.sim import replay, simulator, tracegen
+
+    cfg = LlamaConfig.tiny(vocab_size=128)
+    params = init_llama_params(jax.random.PRNGKey(0), cfg, DTypePolicy.fp32())
+    CHIP_HOUR = 4.2
+    eng_cfg = EngineConfig(
+        prompt_buckets=(16, 32), max_batch_size=8, max_seq_len=128,
+        kv_paged=True, kv_block_size=16,
+    )
+    trace = tracegen.generate(
+        24, seed=17, rate_qps=200.0, prompt_len_range=(4, 24),
+        max_new_range=(8, 24), emit_ids=True, step_period_s=0.01,
+    )
+    for a in trace["arrivals"]:  # tiny vocab: clamp generated ids
+        a["ids"] = [3 + (t % 120) for t in a["ids"]]
+
+    rec_was = flight.recorder().enabled
+    flight.configure(enabled=True, capacity=65536)
+    flight.recorder().clear()
+    try:
+        eng = ContinuousEngine(
+            cfg, params,
+            sampling=SamplingConfig(do_sample=False, max_new_tokens=24),
+            engine_config=eng_cfg, dtypes=DTypePolicy.fp32(),
+        )
+        eng.warmup(batch_sizes=(eng_cfg.max_batch_size,))
+        drv = replay.LockstepDriver(eng, emit=flight.emit)
+        t0 = time.monotonic()
+        drv.drive(trace)
+        wall_s = time.monotonic() - t0
+        journal = flight.recorder().snapshot()
+        del eng
+    finally:
+        flight.configure(enabled=rec_was)
+
+    extracted = replay.extract_trace(journal)
+    windows = [e for e in journal if e.get("type") == "goodput_window"]
+    meas_busy_s = sum(e.get("dur_ms", 0.0) for e in windows) / 1e3
+    meas_steps = sum(
+        e.get("steps", 0) for e in journal
+        if e.get("type") == "sync_window_close"
+    )
+    meas_steps_per_s = meas_steps / max(meas_busy_s, 1e-9)
+
+    res = simulator.simulate(
+        extracted,
+        step_model=simulator.CalibratedStepModel.from_journal(journal),
+        buckets=eng_cfg.prompt_buckets,
+        max_batch_size=eng_cfg.max_batch_size,
+        max_seq_len=eng_cfg.max_seq_len,
+        block_size=eng_cfg.kv_block_size,
+        chip_hour_usd=CHIP_HOUR,
+    )
+    sim_busy_s = res["report"]["busy_s"]
+    sim_steps_per_s = res["decode_steps"] / max(sim_busy_s, 1e-9)
+    meas_cost = meas_busy_s / 3600.0 * CHIP_HOUR
+
+    # speedup at capacity-planning scale: a few hundred synthetic
+    # requests through the 8B roofline model — the workload the harness
+    # exists for — not the tiny recording above, whose handful of
+    # virtual milliseconds can't amortize host overhead
+    cap = simulator.simulate(
+        tracegen.generate(300, seed=17, emit_ids=False),
+        max_batch_size=8, max_seq_len=1024, buckets=(128, 256, 512),
+        chip_hour_usd=CHIP_HOUR,
+    )
+
+    return {
+        "replay_fidelity": {
+            "requests": len(extracted["arrivals"]),
+            "measured_steps_per_s": round(meas_steps_per_s, 1),
+            "simulated_steps_per_s": round(sim_steps_per_s, 1),
+            "steps_per_s_ratio": round(
+                sim_steps_per_s / max(meas_steps_per_s, 1e-9), 4
+            ),
+            "measured_busy_s": round(meas_busy_s, 4),
+            "simulated_busy_s": round(sim_busy_s, 4),
+            "cost_ratio": round(
+                res["report"]["cost"]["busy_usd"] / max(meas_cost, 1e-12), 4
+            ),
+            "sim_speedup_x": round(cap["speedup_x"], 1),
+            "sim_wall_s": round(cap["wall_s"], 4),
+            "sim_requests": len(cap["results"]),
+            "replay_wall_s": round(wall_s, 2),
+        }
+    }
+
+
 def measure_ingest_scale() -> dict:
     """VERDICT r4 #6: corpus-scale ingest THROUGH the HTTP path, snapshot
     save/load timing at that size, and live-index /query probes.
@@ -3048,6 +3160,7 @@ def bench_legs(line: dict):
         ("flight_overhead", lambda: line.update(measure_flight_overhead())),
         ("goodput_overhead", lambda: line.update(measure_goodput_overhead())),
         ("shadow_overhead", lambda: line.update(measure_shadow_overhead())),
+        ("replay_fidelity", lambda: line.update(measure_replay_fidelity())),
         ("query_e2e", lambda: line.update(measure_query_e2e())),
         ("ingest_scale", lambda: line.update(measure_ingest_scale())),
     ]

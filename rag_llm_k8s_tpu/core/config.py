@@ -900,6 +900,12 @@ class FlightConfig:
     # TPU_RAG_FAULTS itself — arming stays strictly opt-in)
     # (env TPU_RAG_DEBUG)
     debug_endpoints: bool = False
+    # record prompt token ids on each arrival event (the replay trace
+    # record, docs/REPLAY.md) — ON by default so a journal replays with
+    # exact token streams; turn OFF when prompts are sensitive and a
+    # shape-only replay (lengths, not ids) is enough
+    # (env TPU_RAG_FLIGHT_ARRIVAL_IDS)
+    arrival_ids: bool = True
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "FlightConfig":
@@ -916,6 +922,7 @@ class FlightConfig:
 
         _flag("TPU_RAG_FLIGHT", "enabled")
         _flag("TPU_RAG_DEBUG", "debug_endpoints")
+        _flag("TPU_RAG_FLIGHT_ARRIVAL_IDS", "arrival_ids")
         if "TPU_RAG_FLIGHT_EVENTS" in env:
             n = int(env["TPU_RAG_FLIGHT_EVENTS"])
             if n < 1:

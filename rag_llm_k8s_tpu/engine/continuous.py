@@ -81,6 +81,12 @@ from rag_llm_k8s_tpu.obs import goodput as obs_goodput
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
 from rag_llm_k8s_tpu.resilience import faults
 from rag_llm_k8s_tpu.resilience.deadline import Deadline, DeadlineExceeded
+# the scheduler's decision core lives behind the sim seam (ISSUE 17):
+# admission verdicts, window planning, budget splits and preemption
+# ordering are pure functions in sim/policy.py, shared verbatim with the
+# replay driver and the pure-host simulator — this module keeps only the
+# device execution and the stateful reclaim loops around them
+from rag_llm_k8s_tpu.sim import policy as sim_policy
 from rag_llm_k8s_tpu.utils.buckets import bucket_len
 
 logger = logging.getLogger(__name__)
@@ -2240,23 +2246,15 @@ class ContinuousEngine:
         blocks; 'never' — the prompt alone outsizes the whole pool."""
         if not self.paged:
             return "ok"
-        need = self.blocks_needed(prompt_len)
-        if need > self.kv_pool.usable_blocks():
-            return "never"
-        if self.interleave_on:
-            # incremental admission: blocks are allocated per CHUNK by the
-            # window planner (which reclaims re-buildable registrations
-            # under pressure and idles/preempts pending admissions last) —
-            # a free row is the only up-front gate, and the scheduler
-            # checks that separately
-            return "ok"
-        # +1 headroom: the first decode window must be able to open the
-        # next block, or admission instantly preempts what it just
-        # admitted. Capped at MB — a row's lifetime growth never exceeds
-        # one full window of blocks, so a prompt that exactly fills the
-        # pool's row capacity needs no headroom at all (without the cap a
-        # minimum-size pool would 'never' a prompt it can fully serve)
-        want = min(need + 1, self.MB)
+        # the verdict arithmetic (never / incremental-ok / +1-headroom
+        # want) is the decision core's; only the stateful reclaim loop
+        # below stays here
+        verdict, want = sim_policy.admission_verdict(
+            self.blocks_needed(prompt_len), self.kv_pool.usable_blocks(),
+            self.interleave_on, self.MB,
+        )
+        if verdict != "check":
+            return verdict
         if self.kv_pool.can_alloc(want):
             return "ok"
         if self._prefix_blocks or self._chunk_regs:
@@ -2309,25 +2307,20 @@ class ContinuousEngine:
         the scheduler, which resubmits once blocks free — vLLM-style
         recompute preemption) until the remaining rows fit."""
         k = self.sync_steps
-        bs = self.block_size
         while True:
-            short = []  # (admit_seq, row, blocks_missing, blocks_have)
-            for row, slot in enumerate(self.slots):
-                if not slot.active:
-                    continue
-                # mapped logical blocks are contiguous from 0, so the
-                # ownership list IS the count — no B x MB table rescan on
-                # the hot per-window path
-                have = len(self._slot_blocks[row])
-                h = k if horizon is None else horizon.get(row, 1)
-                need_total = min(
-                    -(-(slot.kv_ub + h) // bs), self.MB
-                )
-                if need_total > have:
-                    short.append((slot.admit_seq, row, need_total - have, have))
+            # mapped logical blocks are contiguous from 0, so the
+            # ownership list IS the count — no B x MB table rescan on
+            # the hot per-window path
+            short = sim_policy.grow_shortfall(
+                (
+                    (slot.admit_seq, row, slot.kv_ub,
+                     len(self._slot_blocks[row]))
+                    for row, slot in enumerate(self.slots) if slot.active
+                ),
+                k, horizon, self.block_size, self.MB,
+            )  # (admit_seq, row, missing, have), oldest admissions first
             if not short:
                 return
-            short.sort()  # oldest admissions grow first
             ok = True
             for _, row, missing, have in short:
                 try:
@@ -2354,14 +2347,10 @@ class ContinuousEngine:
                 self._drop_chunk_reg(next(iter(self._chunk_regs)))
                 continue
             if self._prefix_blocks:
-                victim = min(
-                    self._prefix_blocks,
-                    key=lambda k: (
-                        self._prefix_tier.get(k, "hot") == "hot",
-                        self._prefix_reg_gen.get(k, 0),
-                    ),
-                )
-                self._drop_registration(victim)
+                self._drop_registration(sim_policy.reclaim_registration(
+                    self._prefix_blocks, self._prefix_tier,
+                    self._prefix_reg_gen,
+                ))
                 continue
             if self._chunk_admissions:
                 # pending chunked admissions are the cheapest preemption
@@ -2372,11 +2361,9 @@ class ContinuousEngine:
                 rid, rec = self._chunk_admissions.popitem()
                 self._preempt_chunk_admission(rid, rec)
                 continue
-            victims = [
+            _, victim = sim_policy.preempt_victim(
                 (s.admit_seq, r) for r, s in enumerate(self.slots) if s.active
-            ]
-            victims.sort()
-            seq, victim = victims[-1]
+            )
             vslot = self.slots[victim]
             logger.warning(
                 "kv pool exhausted mid-decode; preempting request %d "
@@ -2548,8 +2535,8 @@ class ContinuousEngine:
 
         prepared = []  # (item_idx, rid, S, p, max_new_c, row_key)
         for i, (rid, prompt, max_new, seed) in enumerate(items):
-            S = bucket_len(max(len(prompt), 1), self.buckets)
-            max_new_c = max(1, min(max_new, self.T - S))
+            S = sim_policy.bucket_len(max(len(prompt), 1), self.buckets)
+            max_new_c = sim_policy.clamp_max_new(max_new, S, self.T)
             p = list(prompt)[-S:]
             if len(prompt) > S:
                 logger.warning(
@@ -2580,29 +2567,22 @@ class ContinuousEngine:
                 )
             return results
 
-        by_bucket: Dict[int, List] = {}
-        for entry in prepared:
-            by_bucket.setdefault(entry[2], []).append(entry)
-
         results: List = [None] * len(items)
         free_iter = iter(free)
-        for S, group in by_bucket.items():
-            pos = 0
-            while pos < len(group):
-                # pow2 chunks keep the executable ladder warmup-friendly
-                n = 1
-                while n * 2 <= min(len(group) - pos, self.B):
-                    n *= 2
-                chunk = group[pos : pos + n]
-                pos += n
-                rows = [next(free_iter) for _ in chunk]
-                try:
-                    self._admit_chunk(S, chunk, rows, results)
-                except EngineStateLost:
-                    raise  # slots are gone for EVERYONE; callers must fail
-                except BaseException as e:  # noqa: BLE001 — per-chunk isolation
-                    for i, _, _, _, _, _ in chunk:
-                        results[i] = e
+        # same-bucket grouping in pow2 chunks (warmup-friendly executable
+        # ladder), arrival order preserved — the decision core plans it
+        for S, member_idx in sim_policy.admission_chunks(
+            [(j, entry[2]) for j, entry in enumerate(prepared)], self.B
+        ):
+            chunk = [prepared[j] for j in member_idx]
+            rows = [next(free_iter) for _ in chunk]
+            try:
+                self._admit_chunk(S, chunk, rows, results)
+            except EngineStateLost:
+                raise  # slots are gone for EVERYONE; callers must fail
+            except BaseException as e:  # noqa: BLE001 — per-chunk isolation
+                for i, _, _, _, _, _ in chunk:
+                    results[i] = e
         return results
 
     def _admit_chunk_t0(self) -> float:
@@ -2914,28 +2894,26 @@ class ContinuousEngine:
         # preempts pending chunked admissions before any decoding row
         self._ensure_decode_blocks(horizon={})
         n_dec = sum(1 for s in self.slots if s.active)
-        remaining = max(0, self.window_budget - n_dec)
+        # the budget split (decode lanes first, remainder FIFO over the
+        # pending admissions in chunk_tokens slices) is the decision
+        # core's; this loop only stages each slice's blocks, idling the
+        # younger admissions at the first slice the pool cannot take
         sched = []  # (rid, rec, offset, take, final)
-        blocked = False
-        for rid, rec in list(self._chunk_admissions.items()):
-            if remaining <= 0 or blocked:
-                break
+        for rid, off, take, final in sim_policy.plan_mixed_window(
+            [(rid, len(rec["prompt"]), rec["progress"])
+             for rid, rec in self._chunk_admissions.items()],
+            self.window_budget, n_dec, C,
+        ):
+            rec = self._chunk_admissions[rid]
             row = rec["row"]
-            left = len(rec["prompt"]) - rec["progress"]
-            take = min(C, remaining, left)
-            if take <= 0:
-                continue
-            need = self.kv_pool.blocks_for(rec["progress"] + take)
+            need = self.kv_pool.blocks_for(off + take)
             have = len(self._slot_blocks[row])
             if need > have:
                 ids = self._alloc_chunk_blocks(need - have)
                 if ids is None:
-                    blocked = True  # pool pressure: idle the rest this window
-                    break
+                    break  # pool pressure: idle the rest this window
                 self._assign_row_blocks(row, ids, start_block=have)
-            final = rec["progress"] + take >= len(rec["prompt"])
-            sched.append((rid, rec, rec["progress"], take, final))
-            remaining -= take
+            sched.append((rid, rec, off, take, final))
         flight.emit(
             "window_budget", budget=self.window_budget, decode_lanes=n_dec,
             chunk_tokens=sum(t for _, _, _, t, _ in sched),
@@ -3493,6 +3471,18 @@ class ContinuousScheduler:
             request_id=rid, prompt=list(prompt), max_new=max_new, seed=seed,
             deadline=deadline, retries_left=self.retries,
         )
+        # the replay trace record (sim/replay.py): everything a re-drive
+        # needs to reproduce this request — the prompt token ids ride
+        # along only while the arrival_ids knob is on (they dominate the
+        # ring's memory at long prompts)
+        arr = {"prompt_len": len(item.prompt), "max_new": max_new}
+        if seed is not None:
+            arr["seed"] = seed
+        if deadline is not None:
+            arr["deadline_ms"] = deadline.budget_ms
+        if flight.arrival_ids():
+            arr["ids"] = list(item.prompt)
+        flight.emit("arrival", rid, **arr)
         with self._lifecycle_lock:  # stop-check + enqueue must be atomic
             if self._stop.is_set():
                 raise RuntimeError("scheduler is shut down")
@@ -3810,7 +3800,8 @@ class ContinuousScheduler:
         and the "seamless continuation" would be conditioned on a different
         prompt; restarting from scratch is exact. Shared by reset recovery
         and pool-preemption resume."""
-        if toks and len(it.prompt) + len(toks) <= max(self.engine.buckets):
+        if sim_policy.resume_fits(len(it.prompt), len(toks),
+                                  max(self.engine.buckets)):
             it.emitted.extend(toks)
             it.prompt = list(it.prompt) + toks
             it.max_new = max(1, it.max_new - len(toks))

@@ -51,9 +51,12 @@ __all__ = [
     "SCHEMA_VERSION",
     "FlightRecorder",
     "IncidentSpooler",
+    "arrival_ids",
     "config_fingerprint",
     "configure",
     "emit",
+    "export_journal",
+    "load_journal",
     "recorder",
     "stream_hash",
 ]
@@ -70,6 +73,10 @@ SCHEMA_VERSION = 1
 # docs/OBSERVABILITY.md (ragcheck EVENT-REGISTRY enforces all three ways).
 EVENTS: Dict[str, str] = {
     # -- continuous engine / scheduler (engine/continuous.py) ------------
+    "arrival": "request submitted to the scheduler (prompt_len, max_new; "
+               "seed/deadline_ms when set; prompt token ids while the "
+               "arrival_ids knob is on) — the replay trace record "
+               "sim/replay.py re-drives a journal from",
     "admit": "request admitted into a decode slot (slot, prompt_len, "
              "bucket, tok0; prefixed admissions add prefix_len/shared)",
     "sync_window_open": "decode sync window dispatched (steps, active rows)",
@@ -187,11 +194,16 @@ class FlightRecorder:
     tuples, so a snapshot is always internally consistent.
     """
 
-    def __init__(self, capacity: int = 4096, enabled: bool = True):
+    def __init__(self, capacity: int = 4096, enabled: bool = True,
+                 arrival_ids: bool = True):
         if capacity < 1:
             raise ValueError(f"capacity={capacity}: expected >= 1")
         self.capacity = int(capacity)
         self.enabled = bool(enabled)
+        # whether ``arrival`` events carry the prompt token ids (the
+        # exact-replay trace record); off, they keep prompt_len only —
+        # the journal stays sized in events, not prompt tokens
+        self.arrival_ids = bool(arrival_ids)
         self._lock = threading.Lock()
         self._buf: List[Optional[tuple]] = [None] * self.capacity
         self._next = 0  # total events ever emitted (seq of the next event)
@@ -286,7 +298,8 @@ def recorder() -> FlightRecorder:
 
 
 def configure(enabled: Optional[bool] = None,
-              capacity: Optional[int] = None) -> FlightRecorder:
+              capacity: Optional[int] = None,
+              arrival_ids: Optional[bool] = None) -> FlightRecorder:
     """Apply ``FlightConfig`` to the process recorder (the service calls
     this at construction; bench legs toggle ``enabled`` directly). A
     capacity change rebuilds the ring (journal starts fresh); an
@@ -296,9 +309,12 @@ def configure(enabled: Optional[bool] = None,
         _RECORDER = FlightRecorder(
             int(capacity),
             _RECORDER.enabled if enabled is None else bool(enabled),
+            _RECORDER.arrival_ids if arrival_ids is None else bool(arrival_ids),
         )
     elif enabled is not None:
         _RECORDER.enabled = bool(enabled)
+    if arrival_ids is not None:
+        _RECORDER.arrival_ids = bool(arrival_ids)
     return _RECORDER
 
 
@@ -309,6 +325,58 @@ def emit(etype: str, request_id: Optional[int] = None, **attrs) -> None:
     if not rec.enabled:
         return
     rec.emit(etype, request_id, **attrs)
+
+
+def arrival_ids() -> bool:
+    """Whether ``arrival`` events should carry prompt token ids — read at
+    the emit site (engine/continuous.py submit); False when the recorder
+    is disabled outright, so callers need not re-check ``enabled``."""
+    rec = _RECORDER
+    return rec.enabled and rec.arrival_ids
+
+
+# ---------------------------------------------------------------------------
+# journal export / ingest (the replay harness's file format)
+# ---------------------------------------------------------------------------
+
+
+def export_journal(path: str, events: Optional[List[Dict]] = None,
+                   meta: Optional[Dict] = None) -> Dict:
+    """Write the process journal (or an explicit ``events`` list — e.g. a
+    simulator's synthetic journal) as a flightview-loadable JSON bundle:
+    ``{"schema_version", "journal", ...meta}``. Returns the bundle."""
+    bundle: Dict = {
+        "schema_version": SCHEMA_VERSION,
+        "journal": _RECORDER.snapshot() if events is None else list(events),
+    }
+    if meta:
+        for k, v in meta.items():
+            bundle.setdefault(k, v)
+    with open(path, "w") as f:
+        json.dump(bundle, f, separators=(",", ":"))
+    return bundle
+
+
+def load_journal(path: str) -> List[Dict]:
+    """Read a journal written by ``export_journal`` (or a spooled incident
+    bundle, or a bare event list) back to its event list. A NEWER schema
+    loads with a warning — the replay parser (sim/replay.py) skips event
+    types it does not know, so a best-effort read beats a refusal here;
+    flightview keeps its own stricter gate for rendering."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        return doc
+    ver = doc.get("schema_version")
+    if ver is not None and int(ver) > SCHEMA_VERSION:
+        logger.warning(
+            "journal %s has schema_version %s (this build knows %s); "
+            "unknown event types will be skipped", path, ver, SCHEMA_VERSION,
+        )
+    journal = doc.get("journal")
+    if not isinstance(journal, list):
+        raise ValueError(f"{path}: no 'journal' event list in bundle")
+    return journal
 
 
 # ---------------------------------------------------------------------------

@@ -19,7 +19,12 @@ dicts join with '.'), ordered first-match-wins:
   sizes (``snapshot_bytes``);
 - ignored: counts/config echoes (``*_n``, ``batch``, booleans, strings,
   lists, ``truncated`` markers) — they are workload descriptors, not
-  performance.
+  performance;
+- band (ideal = 1.0): fidelity ratios (``steps_per_s_ratio``,
+  ``cost_ratio`` — the replay simulator's predicted-over-measured figures,
+  docs/REPLAY.md) — judged against the ABSOLUTE ``1.0 ± tolerance`` band,
+  not against the baseline, because drifting high is exactly as wrong as
+  drifting low.
 
 Keys present in only one document are reported as ``missing`` (information,
 not failure, unless ``strict``): bench legs evolve round over round and the
@@ -68,6 +73,11 @@ _RULES: Tuple[Tuple[re.Pattern, str], ...] = tuple(
         # chunk-reuse leg's exact-policy CONTROL numbers (reported for
         # contrast, deliberately unjudged) — must precede the qps rule
         (r"exact_skip_frac|exact_resolve_qps", "ignore"),
+        # -- band: ideal is exactly 1.0 -----------------------------------
+        # replay-fidelity leg (ISSUE 17, docs/REPLAY.md): the simulator's
+        # predicted-over-measured ratios — must precede the _per_s rule,
+        # which would read steps_per_s_ratio=1.4 as an "improvement"
+        (r"steps_per_s_ratio|cost_ratio", "band"),
         # -- higher is better ---------------------------------------------
         (r"tok_per_s|tokens_per_sec|per_s$|_per_s(\.|_|$)|qps", "higher"),
         (r"mfu|vs_baseline|tokens_per_verify|reduction", "higher"),
@@ -103,7 +113,7 @@ _RULES: Tuple[Tuple[re.Pattern, str], ...] = tuple(
 
 
 def classify(key: str) -> str:
-    """'higher' | 'lower' | 'ignore' for one flattened key."""
+    """'higher' | 'lower' | 'band' | 'ignore' for one flattened key."""
     for rx, direction in _RULES:
         if rx.search(key):
             return direction
@@ -136,6 +146,12 @@ class Finding:
             return f"{self.key}: absent from {side}"
         arrow = "↑" if (self.ratio or 1.0) >= 1.0 else "↓"
         pct = abs((self.ratio or 1.0) - 1.0) * 100.0
+        if self.direction == "band":
+            off = abs((self.current if self.current is not None else 1.0) - 1.0)
+            return (
+                f"{self.key}: {self.baseline:g} → {self.current:g} "
+                f"({off * 100.0:.1f}% off the 1.0 fidelity ideal)"
+            )
         want = "lower" if self.direction == "lower" else "higher"
         return (
             f"{self.key}: {self.baseline:g} → {self.current:g} "
@@ -159,7 +175,9 @@ def compare(
 
     A metric regresses when it moves the BAD way past the relative band:
     lower-is-better: ``current > baseline * (1 + tolerance)``;
-    higher-is-better: ``current < baseline * (1 - tolerance)``.
+    higher-is-better: ``current < baseline * (1 - tolerance)``;
+    band (ideal 1.0): ``abs(current - 1) > tolerance`` regardless of the
+    baseline — the fidelity contract is absolute.
     Baselines of 0 compare only for direction (any bad nonzero flags).
     """
     cur = flatten(current)
@@ -178,7 +196,12 @@ def compare(
             out["missing"].append(Finding(key, "missing", direction, bv, cv, None))
             continue
         ratio = cv / bv if bv else (math.inf if cv > 0 else 1.0)
-        if direction == "lower":
+        if direction == "band":
+            # absolute band around the 1.0 ideal — the baseline only
+            # matters for "improvement" (moved meaningfully closer to 1)
+            bad = abs(cv - 1.0) > tolerance
+            good = abs(cv - 1.0) < abs(bv - 1.0) * (1.0 - tolerance)
+        elif direction == "lower":
             bad = cv > bv * (1.0 + tolerance) if bv else cv > 0
             good = cv < bv * (1.0 - tolerance)
         else:

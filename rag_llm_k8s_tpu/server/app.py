@@ -181,7 +181,10 @@ class RagService:
         # APPLIES its config and owns the incident spool
         fl = getattr(config, "flight", None)
         if fl is not None:
-            obs_flight.configure(enabled=fl.enabled, capacity=fl.capacity)
+            obs_flight.configure(
+                enabled=fl.enabled, capacity=fl.capacity,
+                arrival_ids=fl.arrival_ids,
+            )
         self.incidents = (
             obs_flight.IncidentSpooler(
                 fl.spool_dir, fl.spool_max, fl.cooldown_s

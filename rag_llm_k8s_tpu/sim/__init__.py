@@ -1,0 +1,22 @@
+"""Journal-replay harness: the continuous scheduler's decision core,
+factored behind a narrow seam (ISSUE 17, docs/REPLAY.md).
+
+Import discipline: every module in this package is stdlib-only and makes
+NO package-internal imports — the same loaded-by-file-path contract
+``obs/goodput.py`` and ``obs/shadow.py`` carry (mechanized by ragcheck's
+SIM-PURITY rule). ``scripts/flightview.py`` and offline capacity-planning
+scripts load these files by path on hosts with no jax installed; sibling
+modules reach each other through ``policy.load_sibling``.
+
+Module map:
+    policy.py     the pure decision core (block arithmetic, admission
+                  verdicts, window planning, preemption ordering) — the
+                  single source engine/continuous.py delegates to
+    replay.py     journal parsing (forward-compatible), decision-stream
+                  extraction/diffing, and the deterministic lockstep
+                  driver that re-drives a trace against a live engine
+    simulator.py  pure-host scheduler simulator: steps the decision core
+                  with modeled window times, emits a flight-schema journal
+    tracegen.py   seeded synthetic trace generator (sessions, bursts,
+                  hot-chunk skew, tenant mixes)
+"""

@@ -88,6 +88,13 @@ REQUIRED_KEYS = (
     # lower-is-better) — a silently dropped leg must fail the gate, not
     # read as "admission-churn occupancy unjudged"
     "chunked_prefill.bubble_frac",
+    # ISSUE 17: the replay simulator's fidelity headline — simulated
+    # steps/s over the measurement its step model was calibrated on
+    # (acceptance: within ±25% of 1.0; regression.classify judges it
+    # "band" — drifting high is as wrong as drifting low). A silently
+    # dropped leg must fail the gate, not read as "capacity-planning
+    # predictions unjudged" (docs/REPLAY.md)
+    "replay_fidelity.steps_per_s_ratio",
 )
 
 

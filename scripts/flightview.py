@@ -19,7 +19,13 @@ copied off a pod's spool directory) — or a bare journal dump — into:
   divergence rate, logit-err/first-divergence distributions and
   per-approximation attribution, rebuilt from the journal's
   ``shadow_audit`` events by the SAME renderer ``GET /debug/quality``
-  uses live (rag_llm_k8s_tpu/obs/shadow.py, same jax-free contract).
+  uses live (rag_llm_k8s_tpu/obs/shadow.py, same jax-free contract);
+- **the replay diff** (``--replay-diff OTHER``): event-by-event
+  comparison of two journals' scheduler decision streams — the first
+  divergent decision, per-event-type count deltas, occupancy deltas —
+  via rag_llm_k8s_tpu/sim/replay.py (same jax-free contract). This is
+  how a ``make replay-smoke`` failure or a live-vs-simulated run is
+  triaged (docs/REPLAY.md).
 
 No live pod, no jax, no third-party deps — a bundle is self-contained by
 contract (docs/OBSERVABILITY.md "Engine flight recorder").
@@ -30,6 +36,7 @@ Usage:
     python scripts/flightview.py BUNDLE.json --request 7
     python scripts/flightview.py BUNDLE.json --goodput [--chip-hour-usd X]
     python scripts/flightview.py BUNDLE.json --quality
+    python scripts/flightview.py RECORDED.json --replay-diff REPLAYED.json
 
 Input shapes accepted: a full incident bundle (``{"journal": [...],
 "trigger": ..., ...}``), a journal-only dump (``{"journal": [...]}``), or
@@ -223,6 +230,70 @@ def build_goodput_report(events: List[Dict],
     )
 
 
+def _load_sim_module(name: str):
+    """Load a sim/ module by file path — same laptop contract as
+    ``_load_obs_module`` (the modules are stdlib-only by SIM-PURITY and
+    load their own siblings by path)."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir,
+        "rag_llm_k8s_tpu", "sim", f"{name}.py",
+    )
+    spec = importlib.util.spec_from_file_location(f"_flightview_{name}", path)
+    if spec is None or spec.loader is None:
+        raise SystemExit(f"flightview: cannot load {name} module at {path}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def build_replay_diff(events_a: List[Dict], events_b: List[Dict]) -> Dict:
+    """Decision-stream comparison of two journals (recorded vs replayed
+    or simulated) — sim/replay.py's ``diff_journals`` payload."""
+    rp = _load_sim_module("replay")
+    return rp.diff_journals(events_a, events_b)
+
+
+def render_replay_diff_ascii(diff: Dict, name_a: str, name_b: str) -> str:
+    lines = [
+        "replay diff  (A = recorded reference, B = replay/simulation)",
+        f"  A: {name_a}",
+        f"  B: {name_b}",
+        f"  decision streams: identical={diff['identical']}"
+        f"  ({diff['decisions'][0]} vs {diff['decisions'][1]} decisions)",
+    ]
+    fd = diff.get("first_divergence")
+    if fd is not None:
+        lines.append(f"  first divergent decision (index {fd['index']}):")
+        lines.append(f"    A: {json.dumps(fd['a'], sort_keys=True)}")
+        lines.append(f"    B: {json.dumps(fd['b'], sort_keys=True)}")
+    deltas = {
+        t: v for t, v in diff["event_counts"].items() if v["delta"] != 0
+    }
+    lines.append("  event counts (A / B / delta):")
+    for t, v in diff["event_counts"].items():
+        mark = "  <-- " if v["delta"] else ""
+        lines.append(
+            f"    {t:<20} {v['a']:>6} {v['b']:>6} {v['delta']:>+5}{mark}"
+        )
+    if not deltas:
+        lines.append("    (no count deltas)")
+    occ = diff["occupancy"]
+    lines.append(
+        f"  occupancy: windows {occ['a']['windows']} vs "
+        f"{occ['b']['windows']};  mean active rows "
+        f"{occ['a']['mean_active']} vs {occ['b']['mean_active']} "
+        f"(delta {occ['mean_active_delta']:+})"
+    )
+    rd = diff["requests_diverged"]
+    if rd:
+        head = ", ".join(str(r) for r in rd[:16])
+        more = f" (+{len(rd) - 16} more)" if len(rd) > 16 else ""
+        lines.append(f"  requests whose decision chains diverge: {head}{more}")
+    else:
+        lines.append("  per-request decision chains: all identical")
+    return "\n".join(lines)
+
+
 def build_quality_report(events: List[Dict]) -> Dict:
     """The offline half of the quality same-report contract: rebuild the
     auditor state from ``shadow_audit`` events and render with the exact
@@ -321,6 +392,11 @@ def main(argv=None) -> int:
     ap.add_argument("--chip-hour-usd", type=float, default=0.0,
                     help="chip rental price for the --goodput cost figures "
                          "(defaults to 0: attribution only, no dollars)")
+    ap.add_argument("--replay-diff", metavar="OTHER", default=None,
+                    help="compare BUNDLE's scheduler decision stream "
+                         "against OTHER's (a replayed or simulated "
+                         "journal): first divergence, per-event-type "
+                         "count deltas, occupancy deltas")
     args = ap.parse_args(argv)
     try:
         with open(args.bundle) as f:
@@ -329,6 +405,22 @@ def main(argv=None) -> int:
         print(f"flightview: cannot read {args.bundle}: {e}", file=sys.stderr)
         return 2
     events = load_events(doc)
+    if args.replay_diff is not None:
+        try:
+            with open(args.replay_diff) as f:
+                doc_b = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"flightview: cannot read {args.replay_diff}: {e}",
+                  file=sys.stderr)
+            return 2
+        diff = build_replay_diff(events, load_events(doc_b))
+        if args.as_json:
+            print(json.dumps(diff, indent=1))
+        else:
+            print(render_replay_diff_ascii(
+                diff, args.bundle, args.replay_diff
+            ))
+        return 0 if diff["identical"] else 1
     if args.quality:
         report = build_quality_report(events)
         if args.as_json:

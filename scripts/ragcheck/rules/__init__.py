@@ -8,6 +8,7 @@ from scripts.ragcheck.rules.fault_sites import FaultSiteRegistryRule
 from scripts.ragcheck.rules.metric_drift import MetricDriftRule
 from scripts.ragcheck.rules.event_registry import EventRegistryRule
 from scripts.ragcheck.rules.debug_gate import DebugGateRule
+from scripts.ragcheck.rules.sim_purity import SimPurityRule
 
 ALL_RULES = [
     LockDisciplineRule,
@@ -18,6 +19,7 @@ ALL_RULES = [
     MetricDriftRule,
     EventRegistryRule,
     DebugGateRule,
+    SimPurityRule,
 ]
 
 __all__ = ["ALL_RULES"]

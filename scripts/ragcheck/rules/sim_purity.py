@@ -1,0 +1,119 @@
+"""SIM-PURITY: path-loaded modules stay stdlib-only, package-import-free.
+
+A small set of modules is loaded DIRECTLY by file path on hosts that
+hold nothing but a journal file — flightview on a laptop, the replay/
+simulator harness on a CPU pod, capacity-planning scripts. The contract
+that makes that work is twofold and was, until this rule, enforced only
+by convention:
+
+1. **stdlib-only imports** — no jax, no numpy, no third-party anything
+   (the loading host has none of it installed);
+2. **no package-internal imports** — ``import rag_llm_k8s_tpu.…``
+   (absolute or relative) would execute package ``__init__`` chains that
+   pull tracing → jax; path-loaded modules reach siblings through
+   ``sim/policy.py``'s ``load_sibling`` (file-path importlib) instead.
+
+The pure set is every module under ``rag_llm_k8s_tpu/sim/`` plus the
+obs/ modules flightview already path-loads (``flight.py``,
+``goodput.py``, ``shadow.py``). A violation is a landmine: the package
+import works fine in CI (where jax exists) and detonates on the first
+laptop that opens a bundle.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from typing import Iterable, List
+
+from scripts.ragcheck.core import Finding, Repo
+
+PACKAGE = "rag_llm_k8s_tpu"
+
+#: path-loaded obs modules (flightview's `_load_obs_module` targets +
+#: the replay harness's `load_sibling("../obs/...")` targets)
+PURE_OBS = (
+    f"{PACKAGE}/obs/flight.py",
+    f"{PACKAGE}/obs/goodput.py",
+    f"{PACKAGE}/obs/shadow.py",
+)
+
+#: stdlib fallback for interpreters predating sys.stdlib_module_names —
+#: only the modules the pure set actually uses plus common suspects, so
+#: an unknown import fails CLOSED (flagged) rather than open
+_STDLIB_FALLBACK = frozenset({
+    "abc", "argparse", "ast", "bisect", "collections", "contextlib",
+    "copy", "dataclasses", "enum", "functools", "hashlib", "heapq",
+    "importlib", "io", "itertools", "json", "logging", "math", "os",
+    "pathlib", "random", "re", "statistics", "string", "sys",
+    "threading", "time", "types", "typing", "unittest", "warnings",
+    "weakref", "__future__",
+})
+
+
+def _stdlib_names() -> frozenset:
+    names = getattr(sys, "stdlib_module_names", None)
+    return frozenset(names) if names else _STDLIB_FALLBACK
+
+
+class SimPurityRule:
+    id = "SIM-PURITY"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        stdlib = _stdlib_names()
+        targets: List = []
+        for sf in repo.scan_files:
+            if sf.path.startswith(f"{PACKAGE}/sim/"):
+                targets.append(sf)
+        for rel in PURE_OBS:
+            sf = repo.get(rel)
+            if sf is not None:
+                targets.append(sf)
+        for sf in targets:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        yield from self._check(sf, node, alias.name, stdlib)
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level and node.level > 0:
+                        yield Finding(
+                            rule=self.id, path=sf.path, line=node.lineno,
+                            message=(
+                                "relative import in a path-loaded module — "
+                                "there is no package when this file is "
+                                "loaded by path; use policy.load_sibling"
+                            ),
+                            key=f"relative-import:{node.module or ''}",
+                        )
+                        continue
+                    yield from self._check(
+                        sf, node, node.module or "", stdlib
+                    )
+
+    def _check(self, sf, node, modname: str, stdlib) -> Iterable[Finding]:
+        top = modname.split(".", 1)[0]
+        if not top:
+            return
+        if top == PACKAGE:
+            yield Finding(
+                rule=self.id, path=sf.path, line=node.lineno,
+                message=(
+                    f"package-internal import {modname!r} in a path-loaded "
+                    "module — executes package __init__ chains (tracing → "
+                    "jax) on hosts that have neither; use "
+                    "policy.load_sibling to reach siblings by file path"
+                ),
+                key=f"package-import:{modname}",
+            )
+        elif top not in stdlib:
+            yield Finding(
+                rule=self.id, path=sf.path, line=node.lineno,
+                message=(
+                    f"non-stdlib import {modname!r} in a path-loaded "
+                    "module — flightview/replay hosts install no "
+                    "third-party deps; keep the module stdlib-only"
+                ),
+                key=f"nonstdlib-import:{modname}",
+            )

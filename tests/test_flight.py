@@ -383,7 +383,10 @@ class TestTimelineHttp:
         tl = body["timeline"]
         assert tl["request_id"] == body["request_id"]
         types = [e["type"] for e in tl["events"]]
-        assert types[0] == "admit" and types[-1] == "complete"
+        # the lifecycle now begins at submission (the arrival trace
+        # record, ISSUE 17); admission follows
+        assert types[0] == "arrival" and "admit" in types
+        assert types[-1] == "complete"
 
     def test_debug_timeline_endpoint_serves_the_same_chain(
         self, flight_service, monkeypatch
@@ -482,7 +485,7 @@ class TestFlightSmoke:
                 if tl is None:
                     continue  # the 2nd request may post-date this bundle
                 types = [e["type"] for e in tl["events"]]
-                assert types[0] == "admit"
+                assert types[0] in ("arrival", "admit")
                 if tl["complete"]:
                     complete = tl["events"][-1]
                     assert (

@@ -28,6 +28,7 @@ from scripts.ragcheck.rules.jit_hygiene import JitHygieneRule  # noqa: E402
 from scripts.ragcheck.rules.lock_discipline import LockDisciplineRule  # noqa: E402
 from scripts.ragcheck.rules.metric_drift import MetricDriftRule  # noqa: E402
 from scripts.ragcheck.rules.sharding_contract import ShardingContractRule  # noqa: E402
+from scripts.ragcheck.rules.sim_purity import SimPurityRule  # noqa: E402
 
 BASELINE = REPO_ROOT / "scripts" / "ragcheck" / "baseline.json"
 
@@ -692,6 +693,67 @@ class TestDebugGate:
             "rag_llm_k8s_tpu/mod.py": "x = 1\n",
         })
         assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# SIM-PURITY
+# ---------------------------------------------------------------------------
+
+
+class TestSimPurity:
+    def test_flags_every_violation_class(self, tmp_path):
+        fs = run_rule(tmp_path, SimPurityRule, {
+            "rag_llm_k8s_tpu/sim/bad.py": """
+                import jax
+                import numpy as np
+                from rag_llm_k8s_tpu.obs import flight
+                import rag_llm_k8s_tpu.core.config
+                from . import policy
+                import os, json
+                """,
+        })
+        assert keys(fs) == {
+            "nonstdlib-import:jax",
+            "nonstdlib-import:numpy",
+            "package-import:rag_llm_k8s_tpu.obs",
+            "package-import:rag_llm_k8s_tpu.core.config",
+            "relative-import:",
+        }
+        assert all(f.rule == "SIM-PURITY" for f in fs)
+
+    def test_flags_path_loaded_obs_modules(self, tmp_path):
+        fs = run_rule(tmp_path, SimPurityRule, {
+            "rag_llm_k8s_tpu/obs/goodput.py": """
+                import numpy as np
+                import time
+                """,
+        })
+        assert keys(fs) == {"nonstdlib-import:numpy"}
+
+    def test_pure_module_is_silent(self, tmp_path):
+        fs = run_rule(tmp_path, SimPurityRule, {
+            "rag_llm_k8s_tpu/sim/ok.py": """
+                import importlib.util
+                import os
+                from collections import deque
+                from typing import Dict
+                """,
+            # the rest of the package is NOT held to the pure contract
+            "rag_llm_k8s_tpu/engine/dev.py": """
+                import jax
+                from rag_llm_k8s_tpu.obs import flight
+                """,
+        })
+        assert fs == []
+
+    def test_repo_sim_modules_are_pure(self):
+        # the real tree's pure set stays clean — the contract the rule
+        # exists to hold (a finding here means someone imported jax or
+        # the package into a path-loaded module)
+        _, findings = core.run_analysis(
+            str(REPO_ROOT), rules=[SimPurityRule()]
+        )
+        assert findings == [], "\n".join(f.render() for f in findings)
 
 
 # ---------------------------------------------------------------------------

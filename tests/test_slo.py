@@ -667,6 +667,26 @@ class TestRegressionGate:
         assert regression.classify("query_8b_spec_verify_steps") == "ignore"
         assert regression.classify("query_load_quant") == "ignore"
 
+    def test_fidelity_band_is_absolute(self):
+        """ISSUE 17 (docs/REPLAY.md): the replay simulator's fidelity
+        ratios are judged against the absolute 1.0 ± tolerance band —
+        drifting HIGH is exactly as wrong as drifting low, so the _per_s
+        higher-is-better rule must not swallow steps_per_s_ratio."""
+        assert regression.classify("replay_fidelity.steps_per_s_ratio") == "band"
+        assert regression.classify("replay_fidelity.cost_ratio") == "band"
+        base = dict(BASE_BENCH, replay_fidelity={"steps_per_s_ratio": 1.0})
+        for r in (0.8, 1.0, 1.2):  # inside the band: clean
+            cur = dict(BASE_BENCH, replay_fidelity={"steps_per_s_ratio": r})
+            assert regression.compare(cur, base)["regression"] == []
+        for r in (0.7, 1.4):  # outside: flagged in BOTH directions
+            cur = dict(BASE_BENCH, replay_fidelity={"steps_per_s_ratio": r})
+            keys = {f.key for f in regression.compare(cur, base)["regression"]}
+            assert keys == {"replay_fidelity.steps_per_s_ratio"}, r
+        # the band is absolute: an out-of-band baseline does not grant an
+        # out-of-band current a self-comparison pass
+        drifted = dict(BASE_BENCH, replay_fidelity={"steps_per_s_ratio": 1.4})
+        assert regression.compare(drifted, drifted)["regression"]
+
 
 class TestBenchGateCli:
     def _run(self, *args):
