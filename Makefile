@@ -160,6 +160,19 @@ shadow-smoke:
 replay-smoke:
 	env TPU_RAG_FAULTS=1 JAX_PLATFORMS=cpu python -m pytest tests/test_replay.py::TestReplaySmoke -q -p no:cacheprovider
 
+# Tenant-attribution smoke (ISSUE 18, docs/OBSERVABILITY.md "Tenant
+# attribution"): the cardinality-bounded TenantTracker holds K tracked
+# tenants + __other__ under a 10k-id churn storm; a 3-tenant workload
+# through the paged scheduler conserves chip-seconds per tenant (rollup
+# sum tracks the ledger's attributed total within 5%); and
+# scripts/flightview.py --tenants rebuilds byte-identically the SAME
+# report GET /debug/tenants serves live — proven against a poisoned jax
+# import. The full matrix (HELP escaping, re-promotion, scrape-thread
+# safety, lockstep round-trip, SLO reconcile) lives in the rest of
+# tests/test_tenants.py and runs under tier1.
+tenants-smoke:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_tenants.py::TestTenantsSmoke -q -p no:cacheprovider
+
 # Perf regression gate (scripts/bench_gate.py): compare a fresh bench JSON
 # against a committed baseline with per-metric tolerance bands, direction
 # aware (latency up = bad, tok/s down = bad). Defaults to comparing the
@@ -221,7 +234,7 @@ check: test tpu-test bench
 # (validates the baseline + gate plumbing without running the bench — the
 # TPU-judged comparison is `make bench` followed by
 # `make bench-gate BENCH_CURRENT=...`).
-ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke interleave-smoke flight-smoke goodput-smoke shadow-smoke replay-smoke lint analyze
+ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke interleave-smoke flight-smoke goodput-smoke shadow-smoke replay-smoke tenants-smoke lint analyze
 	python scripts/bench_gate.py --baseline $(BENCH_BASELINE) --dry-run
 
-.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke interleave-smoke flight-smoke goodput-smoke shadow-smoke replay-smoke ci lint analyze check validate-8b validate-70b
+.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke interleave-smoke flight-smoke goodput-smoke shadow-smoke replay-smoke tenants-smoke ci lint analyze check validate-8b validate-70b

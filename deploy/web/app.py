@@ -4,7 +4,9 @@ Additions: renders the retrieval context and per-stage timings the TPU server
 returns (the reference drops the 'context' field — web/app.py:15-19), and
 ORIGINATES a W3C ``traceparent`` header per click so one trace id follows the
 request web → server → span tree → structured logs (the server echoes it in
-``x-trace-id``; paste it into ``GET /debug/traces`` or the log search)."""
+``x-trace-id``; paste it into ``GET /debug/traces`` or the log search), and
+sends an ``x-tenant-id`` header (sidebar text field, persisted in session
+state) so per-tenant cost/quality attribution works from the demo UI too."""
 
 import os
 import time
@@ -27,19 +29,22 @@ def new_traceparent() -> str:
     return f"00-{uuid.uuid4().hex}-{uuid.uuid4().hex[:16]}-01"
 
 
-def post_generate(prompt: str, traceparent: str, status_slot):
+def post_generate(prompt: str, traceparent: str, status_slot, tenant: str = ""):
     """One /generate POST with ONE retry on connection errors and on
     overload sheds (429/503), honoring the server's ``Retry-After`` —
     the client half of the admission-control contract. Distinguishes
     'overloaded, retrying' from a hard failure in the UI instead of
     hanging the spinner."""
+    headers = {"traceparent": traceparent}
+    if tenant:
+        headers["x-tenant-id"] = tenant
     last_exc = None
     for attempt in (0, 1):
         try:
             resp = requests.post(
                 f"{LLM_SERVICE_URL}/generate",
                 json={"prompt": prompt},
-                headers={"traceparent": traceparent},
+                headers=headers,
                 timeout=(CONNECT_TIMEOUT_S, READ_TIMEOUT_S),
             )
         except (requests.ConnectionError, requests.Timeout) as e:
@@ -66,13 +71,21 @@ def post_generate(prompt: str, traceparent: str, status_slot):
 
 st.title("RAG LLM (TPU)")
 
+# Tenant id persists across reruns in session state; sent as x-tenant-id so
+# the server's attribution layer (obs/tenants) books this session's cost and
+# quality under a stable name instead of the "anon" default.
+if "tenant_id" not in st.session_state:
+    st.session_state["tenant_id"] = os.environ.get("LLM_TENANT_ID", "")
+st.sidebar.text_input("Tenant id (x-tenant-id)", key="tenant_id")
+
 prompt = st.text_input("Enter your prompt:")
 if st.button("Generate") and prompt:
     traceparent = new_traceparent()
     status_slot = st.empty()
+    tenant = (st.session_state.get("tenant_id") or "").strip()
     try:
         with st.spinner("Generating..."):
-            resp = post_generate(prompt, traceparent, status_slot)
+            resp = post_generate(prompt, traceparent, status_slot, tenant=tenant)
     except (requests.ConnectionError, requests.Timeout) as e:
         status_slot.empty()
         st.error(f"Could not reach the LLM service: {e}")

@@ -252,6 +252,9 @@ class BatchScheduler:
         deadline=None,  # Optional[resilience.Deadline]
         info: Optional[dict] = None,  # accepted for scheduler-API parity;
         # only the continuous scheduler has per-request engine facts to fill
+        tenant: Optional[str] = None,  # parity again: the continuous path
+        # stamps tenant into the flight journal / goodput ledger; one-shot
+        # batches carry no per-request ledger rows to attribute
     ) -> List[int]:
         """Blocking: enqueue and wait for this prompt's continuation.
 

@@ -98,6 +98,15 @@ logger = logging.getLogger(__name__)
 _REQUEST_IDS = itertools.count(1)
 
 
+def _tenant_attr(ledger, rid: int) -> Dict[str, str]:
+    """``{"tenant": ...}`` for an admit-time flight emit when the edge
+    stamped one on this request (goodput.note_tenant at submit), else
+    empty — admit sites only know the rid, and an un-attributed journal
+    must not grow ``tenant: None`` noise on every event."""
+    t = ledger.tenant_of(rid) if ledger is not None else None
+    return {"tenant": t} if t else {}
+
+
 class EngineStateLost(RuntimeError):
     """A device failure invalidated donated engine buffers; the engine has
     been reset and every request that was in flight is gone."""
@@ -880,6 +889,7 @@ class ContinuousEngine:
         flight.emit(
             "admit", request_id, slot=row, prompt_len=total,
             prefix_len=int(prefix.length), tok0=tok0,
+            **_tenant_attr(self.ledger, request_id),
         )
         self._journal_window(self.ledger.record_prefill_px(
             time.perf_counter() - t_admit, bucket=C, rid=request_id,
@@ -1008,6 +1018,7 @@ class ContinuousEngine:
         flight.emit(
             "admit", request_id, slot=row, prompt_len=total, prefix_len=plen,
             shared=shared_tok, tok0=tok0,
+            **_tenant_attr(self.ledger, request_id),
         )
         self._journal_window(self.ledger.record_prefill_px(
             time.perf_counter() - t_admit, bucket=C, rid=request_id,
@@ -2206,11 +2217,13 @@ class ContinuousEngine:
         self._rework_rids -= taken
         return taken
 
-    def pop_request_goodput(self, request_id: int) -> Optional[Dict]:
+    def pop_request_goodput(self, request_id: int,
+                            tokens: float = 0.0) -> Optional[Dict]:
         """One completed request's attributed chip-time figures (chip_ms,
         goodput_frac, cost_usd, speculation stats) — the scheduler
-        forwards them into the response timings at delivery."""
-        return self.ledger.pop_request(request_id)
+        forwards them into the response timings at delivery. ``tokens``
+        (the delivered count) feeds the ledger's per-tenant rollup."""
+        return self.ledger.pop_request(request_id, tokens=tokens)
 
     def pop_spec_seen(self, request_id: int) -> bool:
         """True iff any verify window ever judged drafts for this request
@@ -2651,7 +2664,7 @@ class ContinuousEngine:
                 self.stats.prefill_tokens += len(p)
                 flight.emit(
                     "admit", rid, slot=row, prompt_len=len(p), bucket=S,
-                    tok0=tok0,
+                    tok0=tok0, **_tenant_attr(self.ledger, rid),
                 )
                 if tok0 in self.config.eos_token_ids or max_new_c <= 1:
                     # finished at its very first token: the slot was spliced
@@ -2776,7 +2789,7 @@ class ContinuousEngine:
                 self.stats.prefill_tokens += len(p)
                 flight.emit(
                     "admit", rid, slot=row, prompt_len=len(p), bucket=S,
-                    tok0=tok0,
+                    tok0=tok0, **_tenant_attr(self.ledger, rid),
                 )
                 if tok0 in self.config.eos_token_ids or max_new_c <= 1:
                     out = [] if tok0 in self.config.eos_token_ids else [tok0]
@@ -3021,6 +3034,7 @@ class ContinuousEngine:
             flight.emit(
                 "admit", rid, slot=row, prompt_len=len(p),
                 bucket=rec["bucket"], tok0=tok0,
+                **_tenant_attr(self.ledger, rid),
             )
             ts = rec.get("t_submit", rec["t_admit"])
             if ts is not None:
@@ -3453,6 +3467,7 @@ class ContinuousScheduler:
         timeout: Optional[float] = None,
         deadline: Optional[Deadline] = None,
         info: Optional[Dict] = None,  # out-param: per-request engine facts
+        tenant: Optional[str] = None,  # edge-interned tenant (bounded set)
     ) -> List[int]:
         if self._stop.is_set():
             raise RuntimeError("scheduler is shut down")
@@ -3469,7 +3484,7 @@ class ContinuousScheduler:
             info["request_id"] = rid
         item = _Pending(
             request_id=rid, prompt=list(prompt), max_new=max_new, seed=seed,
-            deadline=deadline, retries_left=self.retries,
+            deadline=deadline, retries_left=self.retries, tenant=tenant,
         )
         # the replay trace record (sim/replay.py): everything a re-drive
         # needs to reproduce this request — the prompt token ids ride
@@ -3480,6 +3495,11 @@ class ContinuousScheduler:
             arr["seed"] = seed
         if deadline is not None:
             arr["deadline_ms"] = deadline.budget_ms
+        if tenant is not None:
+            # rides the trace record too: a re-driven journal re-prices
+            # per tenant (sim/replay.py forwards it into its submits)
+            arr["tenant"] = tenant
+            self.engine.ledger.note_tenant(rid, tenant)
         if flight.arrival_ids():
             arr["ids"] = list(item.prompt)
         flight.emit("arrival", rid, **arr)
@@ -3773,20 +3793,25 @@ class ContinuousScheduler:
         if item.retried:
             self._m_retries.labels(outcome="succeeded").inc()
         item.blocks_allocated = self.engine.pop_blocks_allocated(item.request_id)
-        item.goodput = self.engine.pop_request_goodput(item.request_id)
+        item.result = item.emitted + tokens
+        item.goodput = self.engine.pop_request_goodput(
+            item.request_id, tokens=len(item.result)
+        )
         pop_spec = getattr(self.engine, "pop_spec_seen", None)
         item.spec_seen = bool(pop_spec(item.request_id)) if pop_spec else False
-        item.result = item.emitted + tokens
         # stream_fnv anchors the timeline to the BYTES the client received:
         # a reconstructed lifecycle (admit → reset → resubmit → complete)
         # is provably consistent with the delivered stream. The goodput
         # attribution rides along so an offline journal can compute
-        # cost-per-query percentiles with no live pod.
+        # cost-per-query percentiles with no live pod; the tenant stamp is
+        # what lets obs/tenants.py price the journal per tenant.
         extra = {}
         if item.goodput is not None:
             extra["chip_ms"] = item.goodput["chip_ms"]
             if "cost_usd" in item.goodput:
                 extra["cost_usd"] = round(item.goodput["cost_usd"], 8)
+        if item.tenant is not None:
+            extra["tenant"] = item.tenant
         flight.emit(
             "complete", item.request_id, n_tokens=len(item.result),
             stream_fnv=flight.stream_hash(item.result), **extra,
@@ -3951,3 +3976,4 @@ class _Pending:
     blocks_allocated: Optional[int] = None  # paged: peak block footprint
     goodput: Optional[Dict] = None  # ledger attribution (chip_ms/cost/spec)
     spec_seen: bool = False  # verify windows judged drafts for this request
+    tenant: Optional[str] = None  # edge-interned tenant (complete stamp)

@@ -260,6 +260,11 @@ class GoodputLedger:
 
     MAX_REQUESTS = 8192  # raw-engine callers (tests, benches) never pop
     COST_RING = 512      # completed-request chip_s ring (percentiles)
+    # distinct per-tenant rollup rows (interned names churn slowly through
+    # the top-K tracker; when even that overflows, the coldest row folds
+    # into __other__ — the rollup can never grow with raw-tenant traffic)
+    MAX_TENANT_ROWS = 64
+    OTHER_TENANT = "__other__"
 
     def __init__(
         self,
@@ -279,6 +284,10 @@ class GoodputLedger:
         self._useful_decode_tokens = 0.0
         self._requests: Dict[int, Dict[str, float]] = {}
         self._completed: "deque[float]" = deque(maxlen=self.COST_RING)
+        # tenant attribution: rid -> interned tenant (stamped at submit),
+        # folded into the per-tenant rollup when the request pops
+        self._rid_tenant: Dict[int, str] = {}
+        self._tenant_roll: Dict[str, Dict[str, float]] = {}
 
     # ------------------------------------------------------------------
     # recording (engine thread)
@@ -307,6 +316,78 @@ class GoodputLedger:
         the per-request map nor enter the completed-cost percentiles."""
         with self._lock:
             self._requests.pop(rid, None)
+            self._rid_tenant.pop(rid, None)
+
+    # ------------------------------------------------------------------
+    # tenant attribution (engine/scheduler thread)
+    # ------------------------------------------------------------------
+    def note_tenant(self, rid: int, tenant: str) -> None:
+        """Stamp the (edge-interned, cardinality-bounded) tenant a request
+        belongs to; the request's chip time folds into that tenant's
+        rollup when it pops. NOT gated on ``enabled``: the map also serves
+        ``tenant_of`` (the engine stamps ``admit`` events from it), which
+        must work with chip-time attribution off. Cheap — one dict write."""
+        if tenant is None:
+            return
+        with self._lock:
+            if len(self._rid_tenant) >= self.MAX_REQUESTS:
+                for k in list(self._rid_tenant)[: self.MAX_REQUESTS // 2]:
+                    del self._rid_tenant[k]
+            self._rid_tenant[rid] = str(tenant)
+
+    def tenant_of(self, rid: int) -> Optional[str]:
+        """The tenant stamped for an in-flight request (None when the edge
+        never stamped one) — how admit-time emit sites label events for
+        requests they only know by rid."""
+        with self._lock:
+            return self._rid_tenant.get(rid)
+
+    def _fold_tenant(self, tenant: str, r: Dict[str, float],
+                     tokens: float) -> None:
+        """Caller holds ``self._lock``."""
+        roll = self._tenant_roll.get(tenant)
+        if roll is None:
+            if len(self._tenant_roll) >= self.MAX_TENANT_ROWS \
+                    and tenant != self.OTHER_TENANT:
+                cold = min(
+                    (t for t in self._tenant_roll if t != self.OTHER_TENANT),
+                    key=lambda t: (self._tenant_roll[t]["chip_s"], t),
+                    default=None,
+                )
+                if cold is not None:
+                    folded = self._tenant_roll.pop(cold)
+                    other = self._tenant_roll.setdefault(
+                        self.OTHER_TENANT,
+                        {"requests": 0.0, "chip_s": 0.0, "useful_s": 0.0,
+                         "tokens": 0.0, "cost_usd": 0.0},
+                    )
+                    for k in other:
+                        other[k] += folded.get(k, 0.0)
+            roll = self._tenant_roll[tenant] = {
+                "requests": 0.0, "chip_s": 0.0, "useful_s": 0.0,
+                "tokens": 0.0, "cost_usd": 0.0,
+            }
+        roll["requests"] += 1.0
+        roll["chip_s"] += r["chip_s"]
+        roll["useful_s"] += r["useful_s"]
+        roll["tokens"] += float(tokens)
+        if self.chip_hour_usd > 0:
+            roll["cost_usd"] += r["chip_s"] / 3600.0 * self.chip_hour_usd
+
+    def tenant_state(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant rollups (chip_s, cost_usd, tokens, goodput_frac) —
+        the live source behind the ``rag_tenant_*`` goodput counters and
+        the per-tenant conservation test (summed rollup chip_s tracks the
+        ledger's attributed total, one dimension finer)."""
+        with self._lock:
+            out: Dict[str, Dict[str, float]] = {}
+            for t, roll in self._tenant_roll.items():
+                row = dict(roll)
+                row["goodput_frac"] = round(
+                    min(1.0, roll["useful_s"] / max(roll["chip_s"], 1e-30)), 6
+                )
+                out[t] = row
+            return out
 
     def _apply(
         self,
@@ -657,18 +738,24 @@ class GoodputLedger:
     # ------------------------------------------------------------------
     # per-request attribution (engine/scheduler thread)
     # ------------------------------------------------------------------
-    def pop_request(self, rid: int) -> Optional[Dict[str, float]]:
+    def pop_request(self, rid: int,
+                    tokens: float = 0.0) -> Optional[Dict[str, float]]:
         """A completed request's attributed figures (None when the ledger
         is disabled or the request never touched it): ``chip_ms``,
         ``goodput_frac``, ``cost_usd`` (when a chip-hour price is set),
         and the speculation stats when the request ever drafted. Feeds the
         /generate timings block; also stamps the completed-cost ring the
-        per-query percentiles read."""
+        per-query percentiles read. ``tokens`` (the delivered count, known
+        only to the caller) feeds the tenant rollup when the request was
+        ``note_tenant``-stamped."""
         with self._lock:
             r = self._requests.pop(rid, None)
+            tenant = self._rid_tenant.pop(rid, None)
             if r is None:
                 return None
             self._completed.append(r["chip_s"])
+            if tenant is not None:
+                self._fold_tenant(tenant, r, tokens)
         out = {
             "chip_ms": round(r["chip_s"] * 1e3, 4),
             "goodput_frac": round(

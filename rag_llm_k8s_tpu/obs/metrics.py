@@ -41,6 +41,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "TenantTracker",
     "default_registry",
     "log_buckets",
     "LATENCY_BUCKETS",
@@ -83,6 +84,15 @@ def _escape_label(v: str) -> str:
     become a two-character escape (a regex prefixing '\\' would leave the
     literal newline in place and split the sample across lines)."""
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """``# HELP`` escaping per the text-format spec: ONLY backslash and
+    newline (quotes stay literal in help text — escaping them like label
+    values would render ``\\"`` into every docstring that quotes a knob).
+    A literal newline would otherwise split the comment and leave a line
+    the scraper rejects as an invalid sample."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _canonical(name: str) -> str:
@@ -289,6 +299,25 @@ class _Family:
         with self._lock:
             return list(self._children.items())
 
+    def prune_label(self, label: str, keep: Sequence[str]) -> int:
+        """Drop every child whose ``label`` value is NOT in ``keep``.
+
+        The cardinality-bound enforcement point: when :class:`TenantTracker`
+        demotes a tenant out of the tracked set, its children leave the
+        exposition so the family can never accumulate more series than the
+        tracked set allows. Children without the label at all (the empty
+        label set, or differently-labeled series) are untouched. Returns
+        the number of children removed."""
+        keep_set = {str(k) for k in keep}
+        with self._lock:
+            doomed = [
+                key for key in self._children
+                if any(n == label and v not in keep_set for n, v in key)
+            ]
+            for key in doomed:
+                del self._children[key]
+        return len(doomed)
+
 
 class MetricsRegistry:
     """Get-or-create registry of metric families + the legacy facade.
@@ -365,16 +394,23 @@ class MetricsRegistry:
         view is the coarse one; the exposition carries the label detail)."""
         out: Dict[str, float] = {}
         for fam in self._families_sorted():
+            items = fam.items()
+            if not items:
+                # a labeled family with no children yet has no samples in
+                # the exposition either — the two views must carry the
+                # same names (bounded tenant families sit empty until
+                # their first tracked tenant)
+                continue
             if fam.kind == "histogram":
                 s = c = 0.0
-                for _, child in fam.items():
+                for _, child in items:
                     s += child.sum
                     c += child.count
                 out[f"{fam.name}_sum"] = s
                 out[f"{fam.name}_count"] = c
             else:
                 total = 0.0
-                for _, child in fam.items():
+                for _, child in items:
                     total += child.value
                 out[fam.name] = total
         return out
@@ -385,7 +421,7 @@ class MetricsRegistry:
         for fam in self._families_sorted():
             name = _canonical(fam.name)
             if fam.help:
-                lines.append(f"# HELP {name} {fam.help}")
+                lines.append(f"# HELP {name} {_escape_help(fam.help)}")
             lines.append(f"# TYPE {name} {fam.kind}")
             for labels, child in sorted(fam.items()):
                 if fam.kind == "histogram":
@@ -404,6 +440,127 @@ class MetricsRegistry:
                         f"{name}{_fmt_labels(labels)} {_fmt_value(child.value)}"
                     )
         return "\n".join(lines) + "\n"
+
+
+class TenantTracker:
+    """Cardinality-bounded tenant label interner: top-K + ``__other__``.
+
+    The registry's labeled families create a child per distinct label
+    value — fed raw tenant ids from millions-of-users traffic they would
+    grow without bound (the same failure PR 15 closed for
+    ``rag_spec_acceptance_rate`` by bucketing). This tracker is the one
+    gate tenant ids pass through before they become label values:
+
+    - ``intern(tenant)`` counts the tenant with a bounded *space-saving*
+      frequency table (capacity entries; a newcomer evicts the global
+      minimum and inherits its count as an overestimate bound) and returns
+      the tenant's own name only while it sits in the current top-K by
+      request count — everything else maps to :data:`TenantTracker.OTHER`.
+      A cold tenant that turns hot re-promotes the moment its count passes
+      the tracked minimum (its pre-promotion history stays in
+      ``__other__`` — attribution is forward-looking by design).
+    - Families registered via ``bind(family, label="tenant")`` are pruned
+      on every demotion AND on every ``prune()`` (the scrape path calls
+      it), so no request pattern can hold more than K+1 tenant children
+      per family: K tracked names plus the overflow bucket.
+
+    Thread-safe: the count table and tracked set live under one lock;
+    family pruning happens outside it (family locks are per-family).
+    """
+
+    OTHER = "__other__"
+
+    def __init__(self, top_k: int = 8, capacity: Optional[int] = None):
+        if top_k < 1:
+            raise ValueError("TenantTracker needs top_k >= 1")
+        self.top_k = int(top_k)
+        self.capacity = int(capacity) if capacity else max(8 * self.top_k, 128)
+        if self.capacity < self.top_k:
+            raise ValueError("TenantTracker capacity must cover top_k")
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._tracked: set = set()
+        self._families: List[Tuple[_Family, str]] = []
+
+    def bind(self, family: _Family, label: str = "tenant") -> _Family:
+        """Register a family whose ``label`` children this tracker bounds."""
+        with self._lock:
+            self._families.append((family, label))
+        return family
+
+    def intern(self, tenant: str) -> str:
+        """Count one request for ``tenant``; return the label value the
+        caller may use: the tenant's own name iff currently tracked, else
+        ``__other__`` (a client claiming ``__other__`` itself lands in the
+        overflow bucket — it can never impersonate a tracked series)."""
+        name = str(tenant)
+        demoted = False
+        with self._lock:
+            if name == self.OTHER:
+                return self.OTHER
+            c = self._counts.get(name)
+            if c is not None:
+                self._counts[name] = c + 1
+            elif len(self._counts) < self.capacity:
+                self._counts[name] = 1
+            else:
+                victim, floor = min(
+                    self._counts.items(), key=lambda kv: (kv[1], kv[0])
+                )
+                del self._counts[victim]
+                self._counts[name] = floor + 1
+                if victim in self._tracked:
+                    self._tracked.discard(victim)
+                    demoted = True
+            if name not in self._tracked:
+                if len(self._tracked) < self.top_k:
+                    self._tracked.add(name)
+                else:
+                    low, low_c = min(
+                        ((t, self._counts.get(t, 0)) for t in self._tracked),
+                        key=lambda kv: (kv[1], kv[0]),
+                    )
+                    # strictly greater: ties keep the incumbent, so two
+                    # equal-rate tenants don't flap the exposition
+                    if self._counts[name] > low_c:
+                        self._tracked.discard(low)
+                        self._tracked.add(name)
+                        demoted = True
+            out = name if name in self._tracked else self.OTHER
+            keep = tuple(self._tracked) + (self.OTHER,)
+            fams = list(self._families) if demoted else ()
+        for fam, label in fams:
+            fam.prune_label(label, keep)
+        return out
+
+    def tracked(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._tracked))
+
+    def prune(self) -> None:
+        """Re-assert the bound over every bound family — the scrape path
+        calls this so a demotion racing an in-flight ``labels()`` call is
+        healed by the next collection at the latest."""
+        with self._lock:
+            keep = tuple(self._tracked) + (self.OTHER,)
+            fams = list(self._families)
+        for fam, label in fams:
+            fam.prune_label(label, keep)
+
+    def snapshot(self) -> Dict[str, object]:
+        """Diagnostics for ``/debug/tenants``: who is tracked and with what
+        (overestimate-bounded) request counts."""
+        with self._lock:
+            tracked = sorted(self._tracked)
+            counts = {t: self._counts.get(t, 0) for t in tracked}
+            table = len(self._counts)
+        return {
+            "top_k": self.top_k,
+            "capacity": self.capacity,
+            "tracked": tracked,
+            "counts": counts,
+            "table_size": table,
+        }
 
 
 _DEFAULT = MetricsRegistry()

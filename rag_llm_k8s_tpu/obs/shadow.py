@@ -136,6 +136,7 @@ def new_state() -> Dict:
         "err_hist": [0] * (len(ERR_BUCKETS) + 1),
         "pos_hist": [0] * (len(POS_BUCKETS) + 1),
         "err_max": 0.0,
+        "tenants": {},  # tenant -> {"clean": n, "diverged": n}
     }
 
 
@@ -157,6 +158,12 @@ def record(state: Dict, ev: Dict) -> None:
     for a in approx:
         slot = state["attribution"].setdefault(a, {"clean": 0, "diverged": 0})
         slot[oc] += 1
+    tenant = ev.get("tenant")
+    if tenant:
+        trow = state.setdefault("tenants", {}).setdefault(
+            str(tenant), {"clean": 0, "diverged": 0}
+        )
+        trow[oc] += 1
     err = float(ev.get("err", 0.0))
     state["err_hist"][_bucket_index(err, ERR_BUCKETS)] += 1
     if err > state["err_max"]:
@@ -231,17 +238,25 @@ def render_report(state: Dict) -> Dict:
                              POS_BUCKETS[-1]),
             "hist": pos_hist,
         },
+        # per-tenant judged-audit split (ISSUE 18): which tenant's traffic
+        # the divergences landed on — absent tenants simply never appear,
+        # so old journals render an empty dict, not an error
+        "tenants": {
+            t: dict(v)
+            for t, v in sorted(state.get("tenants", {}).items())
+        },
     }
 
 
 class _Job:
-    __slots__ = ("request_id", "prompt", "emitted", "approx")
+    __slots__ = ("request_id", "prompt", "emitted", "approx", "tenant")
 
-    def __init__(self, request_id, prompt, emitted, approx):
+    def __init__(self, request_id, prompt, emitted, approx, tenant=None):
         self.request_id = request_id
         self.prompt = prompt
         self.emitted = emitted
         self.approx = approx
+        self.tenant = tenant
 
 
 class ShadowAuditor:
@@ -309,6 +324,7 @@ class ShadowAuditor:
         eligible: bool = True,
         ineligible_reason: str = "sampled",
         force: bool = False,
+        tenant: Optional[str] = None,
     ) -> bool:
         """One delivered response. Returns True when an audit was enqueued.
 
@@ -317,7 +333,9 @@ class ShadowAuditor:
         actually selected it — unsampled traffic is not a "skip".
         ``prompt_fn`` defers prompt-id reconstruction to selection time so
         the 95% unsampled case never pays it. ``force`` bypasses the
-        sampler (the smoke lane and tests)."""
+        sampler (the smoke lane and tests). ``tenant`` (edge-interned)
+        rides the audit so divergence attributes to the tenant whose
+        traffic exercised the approximation."""
         with self._lock:
             self._seen += 1
         if not self.config.enabled:
@@ -327,10 +345,10 @@ class ShadowAuditor:
         with self._lock:
             self._selected += 1
         if not eligible:
-            self._skip(request_id, ineligible_reason)
+            self._skip(request_id, ineligible_reason, tenant=tenant)
             return False
         if not emitted:
-            self._skip(request_id, "empty")
+            self._skip(request_id, "empty", tenant=tenant)
             return False
         if prompt_ids is None and prompt_fn is not None:
             try:
@@ -339,11 +357,11 @@ class ShadowAuditor:
                 logger.exception("shadow prompt reconstruction failed")
                 prompt_ids = None
         if not prompt_ids:
-            self._skip(request_id, "no_prompt")
+            self._skip(request_id, "no_prompt", tenant=tenant)
             return False
         job = _Job(
             request_id, [int(t) for t in prompt_ids],
-            [int(t) for t in emitted], tuple(approx),
+            [int(t) for t in emitted], tuple(approx), tenant=tenant,
         )
         with self._lock:
             if self._stop:
@@ -355,7 +373,7 @@ class ShadowAuditor:
                 self._ensure_worker_locked()
                 self._cv.notify()
                 return True
-        self._skip(request_id, "backlog")
+        self._skip(request_id, "backlog", tenant=tenant)
         return False
 
     # -- worker side ------------------------------------------------------
@@ -377,14 +395,14 @@ class ShadowAuditor:
                 self._inflight = True
             try:
                 if not self._await_headroom():
-                    self._skip(job.request_id, "headroom")
+                    self._skip(job.request_id, "headroom", tenant=job.tenant)
                     continue
                 try:
                     ev = self._audit(job)
                 except ValueError:
                     # the scorer declined the shape (prompt + stream over
                     # its cap) — an honest skip, not a failure
-                    self._skip(job.request_id, "oversize")
+                    self._skip(job.request_id, "oversize", tenant=job.tenant)
                     continue
                 except Exception:  # noqa: BLE001 — an audit crash must stay contained
                     logger.exception(
@@ -394,6 +412,8 @@ class ShadowAuditor:
                         "outcome": "failed", "n": 0,
                         "approx": list(job.approx),
                     }
+                    if job.tenant:
+                        ev["tenant"] = job.tenant
                 self._finish(job.request_id, ev)
             finally:
                 with self._lock:
@@ -424,6 +444,7 @@ class ShadowAuditor:
         minimal logit perturbation that explains the delivered token."""
         score = self.score_fn(job.prompt, job.emitted)
         argmax = score["argmax"]
+        tn = {"tenant": job.tenant} if job.tenant else {}
         first_div = None
         for t, tok in enumerate(job.emitted):
             if int(argmax[t]) != int(tok):
@@ -432,7 +453,7 @@ class ShadowAuditor:
         if first_div is None:
             return {
                 "outcome": "clean", "n": len(job.emitted), "err": 0.0,
-                "approx": list(job.approx),
+                "approx": list(job.approx), **tn,
             }
         gap = float(score["max_logit"][first_div]) - float(
             score["chosen_logit"][first_div]
@@ -442,13 +463,15 @@ class ShadowAuditor:
             "n": first_div + 1,  # tokens compared up to the divergence
             "pos": first_div,
             "err": round(max(gap, 0.0) / 2.0, 6),
-            "approx": list(job.approx),
+            "approx": list(job.approx), **tn,
         }
 
-    def _skip(self, request_id: Optional[int], reason: str) -> None:
-        self._finish(
-            request_id, {"outcome": "skipped", "reason": reason, "n": 0}
-        )
+    def _skip(self, request_id: Optional[int], reason: str,
+              tenant: Optional[str] = None) -> None:
+        ev = {"outcome": "skipped", "reason": reason, "n": 0}
+        if tenant:
+            ev["tenant"] = tenant
+        self._finish(request_id, ev)
 
     def _finish(self, request_id: Optional[int], ev: Dict) -> None:
         with self._lock:
@@ -489,6 +512,9 @@ class ShadowAuditor:
                 "err_hist": list(st["err_hist"]),
                 "pos_hist": list(st["pos_hist"]),
                 "err_max": st["err_max"],
+                "tenants": {
+                    t: dict(v) for t, v in st.get("tenants", {}).items()
+                },
             }
 
     def stats(self) -> Dict[str, float]:

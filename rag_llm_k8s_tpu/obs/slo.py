@@ -77,6 +77,10 @@ class SloSpec:
 
     ``kind='availability'``: good = sample with a non-5xx ``code`` label,
     counted from the named labeled-counter family.
+
+    ``labels`` (optional) restricts the SLI stream to family children whose
+    label set CONTAINS every (name, value) pair — the mechanism per-tenant
+    objectives use: the same family, one tenant's slice of it.
     """
 
     name: str
@@ -85,6 +89,7 @@ class SloSpec:
     objective: float  # fraction of good events, e.g. 0.95
     threshold_s: Optional[float] = None  # latency only
     policy: BurnPolicy = field(default_factory=BurnPolicy)
+    labels: Optional[Tuple[Tuple[str, str], ...]] = None  # child filter
 
     def __post_init__(self):
         if self.kind not in ("latency", "availability"):
@@ -161,6 +166,13 @@ class SloEngine:
         self._ring: Dict[str, List[Tuple[float, float, float]]] = {
             s.name: [] for s in self.specs
         }
+        # per-tracked-tenant objectives (ISSUE 18): tenant -> derived specs.
+        # Reconciled by set_tenants() against the edge's TenantTracker, so
+        # the set is bounded by the tracker's top_k by construction. These
+        # feed the report's "tenants" section only — no per-tenant gauges,
+        # and no vote in the global page/ticket signal (one tenant's burn
+        # is an attribution fact, not a fleet page).
+        self._tenant_specs: Dict[str, List[SloSpec]] = {}
         self._horizon = max(
             max(s.policy.windows()) for s in self.specs
         ) if self.specs else 0.0
@@ -170,6 +182,14 @@ class SloEngine:
             self._register_gauges()
 
     # -- cumulative SLI reads -------------------------------------------
+    @staticmethod
+    def _match(spec: SloSpec, labels) -> bool:
+        """Does a family child belong to this spec's SLI stream?"""
+        if spec.labels is None:
+            return True
+        have = dict(labels)
+        return all(have.get(k) == v for k, v in spec.labels)
+
     def _cumulative(self, spec: SloSpec) -> Tuple[float, float]:
         """(good, total) lifetime counts for one spec, straight off the
         registry. Missing families read as (0, 0) — no traffic yet."""
@@ -179,6 +199,8 @@ class SloEngine:
         if spec.kind == "availability":
             good = total = 0.0
             for labels, child in fam.items():
+                if not self._match(spec, labels):
+                    continue
                 v = child.value
                 total += v
                 code = dict(labels).get("code", "")
@@ -187,7 +209,9 @@ class SloEngine:
             return good, total
         # latency: cumulative count at the bucket bound covering threshold
         good = total = 0.0
-        for _, child in fam.items():
+        for labels, child in fam.items():
+            if not self._match(spec, labels):
+                continue
             counts, _, count = child.snapshot()
             total += count
             # observe() uses bisect_left(bounds, v): every observation
@@ -219,9 +243,12 @@ class SloEngine:
         """Record one cumulative sample per spec (and prune the ring)."""
         t = self.clock() if now is None else now
         with self._lock:
-            for spec in self.specs:
+            specs = list(self.specs)
+            for tenant_specs in self._tenant_specs.values():
+                specs.extend(tenant_specs)
+            for spec in specs:
                 good, total = self._cumulative(spec)
-                ring = self._ring[spec.name]
+                ring = self._ring.setdefault(spec.name, [])
                 if ring and ring[-1][0] >= t:
                     # monotonic guard: a same-instant re-sample replaces
                     ring.pop()
@@ -241,7 +268,7 @@ class SloEngine:
         the first minute of traffic. Zero in-window traffic reads as
         (0.0, 0, 0): no events, no burn.
         """
-        ring = self._ring[name]
+        ring = self._ring.get(name)
         if not ring:
             return 0.0, 0.0, 0.0
         t0 = now - window_s
@@ -276,52 +303,116 @@ class SloEngine:
             if (not force and self._cached is not None
                     and now - self._cached_at < self.min_eval_interval_s):
                 return self._cached
+            tenant_specs = {
+                t: list(ss) for t, ss in sorted(self._tenant_specs.items())
+            }
         self.sample(now)
         slos = []
         any_page = any_ticket = False
         for spec in self.specs:
-            pol = spec.policy
-            budget = 1.0 - spec.objective
-            burn: Dict[str, float] = {}
-            frac_by_w: Dict[float, float] = {}
-            totals: Dict[float, float] = {}
-            with self._lock:  # consistent ring view vs a concurrent sample()
-                for w in pol.windows():
-                    bad_frac, _, total = self._window_rate(spec.name, w, now)
-                    frac_by_w[w] = bad_frac
-                    totals[w] = total
-                    burn[_fmt_window(w)] = round(bad_frac / budget, 3)
-            fast = (frac_by_w[pol.fast_short_s] / budget >= pol.fast_threshold
-                    and frac_by_w[pol.fast_long_s] / budget >= pol.fast_threshold)
-            slow = (frac_by_w[pol.slow_short_s] / budget >= pol.slow_threshold
-                    and frac_by_w[pol.slow_long_s] / budget >= pol.slow_threshold)
-            long_frac = frac_by_w[pol.slow_long_s]
-            remaining = max(0.0, 1.0 - long_frac / budget)
-            entry = {
-                "name": spec.name,
-                "kind": spec.kind,
-                "metric": spec.metric,
-                "objective": spec.objective,
-                "burn_rate": burn,
-                "fast_burn": fast,
-                "slow_burn": slow,
-                "error_budget_remaining": round(remaining, 4),
-                "compliant": long_frac <= budget,
-                "window_events": {
-                    _fmt_window(w): int(t) for w, t in totals.items()
-                },
-            }
-            if spec.kind == "latency":
-                entry["threshold_s"] = spec.threshold_s
-                entry["threshold_bucket_s"] = self.snapped_threshold(spec)
+            entry = self._spec_entry(spec, now)
             slos.append(entry)
-            any_page = any_page or fast
-            any_ticket = any_ticket or slow
-        report = {"slos": slos, "page": any_page, "ticket": any_ticket}
+            any_page = any_page or entry["fast_burn"]
+            any_ticket = any_ticket or entry["slow_burn"]
+        # per-tenant burn (attribution, not paging: a single tenant's burn
+        # names WHO is spending the budget — the fleet page stays with the
+        # aggregate specs above)
+        tenants = {
+            t: [self._spec_entry(s, now) for s in ss]
+            for t, ss in tenant_specs.items()
+        }
+        report = {
+            "slos": slos, "page": any_page, "ticket": any_ticket,
+            "tenants": tenants,
+        }
         with self._lock:
             self._cached = report
             self._cached_at = now
         return report
+
+    def _spec_entry(self, spec: SloSpec, now: float) -> Dict:
+        """The per-SLO report entry — shared by the aggregate and the
+        per-tenant loops so the two sections can never disagree on math."""
+        pol = spec.policy
+        budget = 1.0 - spec.objective
+        burn: Dict[str, float] = {}
+        frac_by_w: Dict[float, float] = {}
+        totals: Dict[float, float] = {}
+        with self._lock:  # consistent ring view vs a concurrent sample()
+            for w in pol.windows():
+                bad_frac, _, total = self._window_rate(spec.name, w, now)
+                frac_by_w[w] = bad_frac
+                totals[w] = total
+                burn[_fmt_window(w)] = round(bad_frac / budget, 3)
+        fast = (frac_by_w[pol.fast_short_s] / budget >= pol.fast_threshold
+                and frac_by_w[pol.fast_long_s] / budget >= pol.fast_threshold)
+        slow = (frac_by_w[pol.slow_short_s] / budget >= pol.slow_threshold
+                and frac_by_w[pol.slow_long_s] / budget >= pol.slow_threshold)
+        long_frac = frac_by_w[pol.slow_long_s]
+        remaining = max(0.0, 1.0 - long_frac / budget)
+        entry = {
+            "name": spec.name,
+            "kind": spec.kind,
+            "metric": spec.metric,
+            "objective": spec.objective,
+            "burn_rate": burn,
+            "fast_burn": fast,
+            "slow_burn": slow,
+            "error_budget_remaining": round(remaining, 4),
+            "compliant": long_frac <= budget,
+            "window_events": {
+                _fmt_window(w): int(t) for w, t in totals.items()
+            },
+        }
+        if spec.kind == "latency":
+            entry["threshold_s"] = spec.threshold_s
+            entry["threshold_bucket_s"] = self.snapped_threshold(spec)
+        return entry
+
+    # -- per-tenant objectives (ISSUE 18) --------------------------------
+    def _make_tenant_specs(self, tenant: str) -> List[SloSpec]:
+        """Derive one availability + one latency objective for a tenant
+        from the aggregate specs, re-pointed at the ``rag_tenant_*``
+        families and filtered to that tenant's children — objectives and
+        policies stay single-sourced from SloConfig."""
+        base = {s.name: s for s in self.specs}
+        out: List[SloSpec] = []
+        avail = base.get("availability")
+        if avail is not None:
+            out.append(SloSpec(
+                f"tenant:{tenant}:availability", "availability",
+                "rag_tenant_http_requests_total",
+                objective=avail.objective, policy=avail.policy,
+                labels=(("tenant", tenant),),
+            ))
+        lat = base.get("request_p95")
+        if lat is not None:
+            out.append(SloSpec(
+                f"tenant:{tenant}:request_p95", "latency",
+                "rag_tenant_request_seconds",
+                objective=lat.objective, threshold_s=lat.threshold_s,
+                policy=lat.policy, labels=(("tenant", tenant),),
+            ))
+        return out
+
+    def set_tenants(self, tenants) -> None:
+        """Reconcile the per-tenant spec set against the tracker's tracked
+        tenants (called from the scrape/evaluate path). A departed tenant's
+        ring is dropped; a newly tracked tenant starts cold — windowed burn
+        becomes meaningful from its first minute of samples, the same
+        cold-start rule the aggregate specs follow."""
+        want = sorted({str(t) for t in tenants if t})
+        with self._lock:
+            if want == sorted(self._tenant_specs):
+                return
+            for t in list(self._tenant_specs):
+                if t not in want:
+                    for s in self._tenant_specs.pop(t):
+                        self._ring.pop(s.name, None)
+            for t in want:
+                if t not in self._tenant_specs:
+                    self._tenant_specs[t] = self._make_tenant_specs(t)
+            self._cached = None  # the report's tenant section changed shape
 
     # -- gauge export ----------------------------------------------------
     def _register_gauges(self) -> None:

@@ -70,6 +70,12 @@ class AdmissionController:
         # None keeps the gate standalone
         self.reject_counter = None
         self.deadline_counter = None
+        # set by the service (tenant attribution): the labeled-counter
+        # family for rag_tenant_sheds_total — per-tenant shed counts, the
+        # data a fair-share gate (ROADMAP item 1) acts on. Label values
+        # arrive pre-interned through the edge's TenantTracker, so the
+        # family stays cardinality-bounded by construction.
+        self.tenant_shed_counter = None
         # set by the service when the engine serves from a paged KV pool
         # (engine/kv_pool.py): a callable returning True while the pool has
         # ZERO free blocks. While saturated, a request that would have to
@@ -93,11 +99,17 @@ class AdmissionController:
         self.incident_hook = None
 
     # -- internals -------------------------------------------------------
-    def _reject(self, reason: str, status: int, retry_after_s: float):
+    def _reject(self, reason: str, status: int, retry_after_s: float,
+                tenant: Optional[str] = None):
         fam = self.reject_counter
         if fam is not None:
             fam.labels(reason=reason).inc()
-        flight.emit("shed", reason=reason, status=status)
+        if tenant is not None:
+            tfam = self.tenant_shed_counter
+            if tfam is not None:
+                tfam.labels(tenant=tenant).inc()
+        flight.emit("shed", reason=reason, status=status,
+                    **({"tenant": tenant} if tenant else {}))
         if reason == "pool_exhausted" and self.incident_hook is not None:
             try:
                 self.incident_hook("pool_exhausted_shed")
@@ -105,7 +117,8 @@ class AdmissionController:
                 pass
         raise AdmissionRejected(reason, status, retry_after_s)
 
-    def _acquire(self, deadline: Optional[Deadline]) -> None:
+    def _acquire(self, deadline: Optional[Deadline],
+                 tenant: Optional[str] = None) -> None:
         breaker = self.breaker
         if breaker is not None and breaker.open:
             # draining: shed EVERYTHING, even below the concurrency cap —
@@ -113,18 +126,21 @@ class AdmissionController:
             self._reject(
                 "breaker_open", 503,
                 max(breaker.retry_after_s(), self.retry_after_s),
+                tenant=tenant,
             )
         with self._cv:
             if self.active < self.max_concurrency and self.waiting == 0:
                 self.active += 1
                 return
             if self.waiting >= self.max_queue:
-                self._reject("queue_full", 429, self.retry_after_s)
+                self._reject("queue_full", 429, self.retry_after_s,
+                             tenant=tenant)
             hint = self.saturation_hint
             if hint is not None and hint():
                 rec = self.reclaimable_hint
                 if rec is None or not rec():
-                    self._reject("pool_exhausted", 429, self.retry_after_s)
+                    self._reject("pool_exhausted", 429, self.retry_after_s,
+                                 tenant=tenant)
                 # else: the pool is full of demotable cache warmth — the
                 # scheduler reclaims it on its next sweep, so this request
                 # waits its bounded turn instead of bouncing a 429
@@ -151,14 +167,17 @@ class AdmissionController:
 
     # -- public ----------------------------------------------------------
     @contextmanager
-    def admit(self, deadline: Optional[Deadline] = None):
+    def admit(self, deadline: Optional[Deadline] = None,
+              tenant: Optional[str] = None):
         """Hold one admission slot for the duration of the request.
 
         Raises :class:`AdmissionRejected` (shed) or
         :class:`DeadlineExceeded` (stage ``queue``) instead of waiting
-        unboundedly.
+        unboundedly. ``tenant`` (edge-interned) attributes any shed to the
+        tenant that suffered it — per-tenant shed counts are the signal a
+        fair-share admission policy will act on.
         """
-        self._acquire(deadline)
+        self._acquire(deadline, tenant=tenant)
         try:
             yield
         finally:

@@ -46,6 +46,7 @@ from rag_llm_k8s_tpu.obs import logging as obs_logging
 from rag_llm_k8s_tpu.obs import metrics as obs_metrics
 from rag_llm_k8s_tpu.obs import shadow as obs_shadow
 from rag_llm_k8s_tpu.obs import slo as obs_slo
+from rag_llm_k8s_tpu.obs import tenants as obs_tenants
 from rag_llm_k8s_tpu.obs import tracing
 from rag_llm_k8s_tpu.rag import lookahead as lookahead_mod
 from rag_llm_k8s_tpu.rag.chunking import split_text
@@ -168,6 +169,18 @@ class RagService:
         )
         if scheduler is not None and hasattr(scheduler, "breaker"):
             scheduler.breaker = self.breaker  # resets feed readiness
+        # tenant attribution (ISSUE 18): every request's tenant id interns
+        # through this cardinality-bounded tracker at the HTTP edge (top-K
+        # by request count + __other__ overflow); the rag_tenant_* families
+        # bind to it below, so their children can never exceed top_k + 1
+        # no matter how many distinct ids arrive
+        tn_cfg = getattr(config, "tenants", None)
+        self.tenants_enabled = (
+            bool(tn_cfg.enabled) if tn_cfg is not None else True
+        )
+        self.tenant_tracker = obs_metrics.TenantTracker(
+            top_k=int(getattr(tn_cfg, "top_k", 8) or 8)
+        )
         # per-scrape memo for the rag_kv_tier_* callback fan-out (see
         # _pcache_tier_stats); must exist before any scrape can fire
         self._tier_stats_memo = None
@@ -817,6 +830,63 @@ class RagService:
             "(the NinjaLLM tokens/s/$ gate's numerator; 0 while no price)",
             fn=lambda: self._goodput_stats().get("tokens_per_usd", 0.0),
         )
+        # tenant-dimensional attribution (ISSUE 18, docs/OBSERVABILITY.md
+        # "Tenant attribution"): who is spending the chips, by the tenant
+        # label the edge interned. Every labeled family here is BOUND to
+        # the TenantTracker, so demotion prunes its children synchronously
+        # and the rag_tenant_tracked callback re-asserts the bound on every
+        # scrape — cardinality is top_k + __other__ by construction, not by
+        # operator discipline. Counters are push-valued at the edge (HTTP
+        # outcome, completion rollup, shed), never per-tenant callbacks.
+        trk = self.tenant_tracker
+        self._m_tenant_http = reg.labeled_counter(
+            "rag_tenant_http_requests_total",
+            "served requests by tenant and status code (tenant values are "
+            "tracker-interned: top-K by request count, everything else "
+            "folds into __other__) — the per-tenant availability SLO's "
+            "good/total source",
+        )
+        self._m_tenant_req = reg.labeled_histogram(
+            "rag_tenant_request_seconds",
+            "end-to-end /generate duration per tracked tenant (the "
+            "per-tenant latency SLO's SLI source)",
+            buckets=obs_metrics.REQUEST_BUCKETS,
+        )
+        self._m_tenant_chip = reg.labeled_counter(
+            "rag_tenant_chip_seconds_total",
+            "chip-seconds attributed to completed requests per tenant — "
+            "the goodput ledger's per-request attribution rolled up by the "
+            "tenant that paid for it (sums to the ledger's attributed "
+            "total over the same requests)",
+        )
+        self._m_tenant_cost = reg.labeled_counter(
+            "rag_tenant_cost_usd_total",
+            "chip rental spend attributed per tenant at "
+            "TPU_RAG_CHIP_HOUR_USD (0 while no price is set)",
+        )
+        self._m_tenant_tokens = reg.labeled_counter(
+            "rag_tenant_tokens_total",
+            "delivered decode tokens per tenant",
+        )
+        self._m_tenant_sheds = reg.labeled_counter(
+            "rag_tenant_sheds_total",
+            "admission-gate sheds per tenant (the reason detail lives in "
+            "rag_admission_rejected_total; this family answers WHO was "
+            "shed)",
+        )
+        self.admission.tenant_shed_counter = self._m_tenant_sheds
+        for tfam in (self._m_tenant_http, self._m_tenant_req,
+                     self._m_tenant_chip, self._m_tenant_cost,
+                     self._m_tenant_tokens, self._m_tenant_sheds):
+            trk.bind(tfam)
+        reg.gauge(
+            "rag_tenant_tracked",
+            "tenants currently holding tracked (non-__other__) label slots "
+            "(<= TPU_RAG_TENANT_TOP_K); reading it also re-asserts the "
+            "cardinality bound over every bound family and reconciles the "
+            "per-tenant SLO spec set",
+            fn=self._tenant_scrape_sync,
+        )
         # per-device HBM + prefix-cache residency (obs/devices.py): the
         # dashboard view of an eviction storm under HBM pressure
         obs_devices.register_device_gauges(reg, self._prefix_bytes_by_device)
@@ -1086,7 +1156,8 @@ class RagService:
         return tuple(sorted(ap))
 
     def _shadow_observe(self, served_by, out_ids, gen_info: Optional[Dict],
-                        prompt_ids=None, prompt_fn=None, cp=None) -> None:
+                        prompt_ids=None, prompt_fn=None, cp=None,
+                        tenant: Optional[str] = None) -> None:
         """Offer one delivered response to the shadow auditor (sampling,
         backlog and headroom discipline live in the auditor). Non-greedy
         streams are ineligible — without the row's keyed draws the exact
@@ -1108,6 +1179,7 @@ class RagService:
                 prompt_ids=prompt_ids,
                 prompt_fn=prompt_fn,
                 eligible=eligible,
+                tenant=tenant,
             )
         except Exception:  # noqa: BLE001 — auditing must not fail serving
             logger.exception("shadow observe failed")
@@ -1148,10 +1220,99 @@ class RagService:
                     out[did] = out.get(did, 0) + nbytes
         return out
 
-    def observe_http(self, route: str, code: int) -> None:
+    def observe_http(self, route: str, code: int,
+                     tenant: Optional[str] = None,
+                     duration_s: Optional[float] = None) -> None:
         """One served request's outcome (called once per request by the
-        route handlers — the availability SLO differences this family)."""
+        route handlers — the availability SLO differences this family).
+        ``tenant`` (edge-interned) additionally feeds the per-tenant
+        outcome counter and, with ``duration_s``, the per-tenant latency
+        histogram — the two families the per-tenant SLO specs window."""
         self._m_http.labels(route=route, code=str(int(code))).inc()
+        if tenant is not None:
+            self._m_tenant_http.labels(
+                tenant=tenant, code=str(int(code))
+            ).inc()
+            if duration_s is not None:
+                self._m_tenant_req.labels(tenant=tenant).observe(duration_s)
+
+    # -- tenant attribution (ISSUE 18, obs/tenants.py) -------------------
+    def _tenant_scrape_sync(self) -> float:
+        """The ``rag_tenant_tracked`` gauge's probe, with two side effects
+        that belong on the scrape cadence: re-assert the cardinality bound
+        over every tracker-bound family (healing the intern-vs-labels
+        race), and reconcile the SLO engine's per-tenant spec set against
+        the tracked tenants."""
+        trk = self.tenant_tracker
+        trk.prune()
+        tracked = trk.tracked()
+        slo = getattr(self, "slo", None)
+        if slo is not None:
+            slo.set_tenants(tracked)
+        return float(len(tracked))
+
+    def _tenant_complete(self, tenant: str, gen_info: Optional[Dict],
+                         n_tokens: int) -> None:
+        """Fold one completed request into the per-tenant rollup counters.
+        Push-based at completion time (the request's OWN goodput
+        attribution), so summed per-tenant chip-seconds equal the ledger's
+        attributed total over the same requests — the conservation
+        property tests/test_tenants.py pins."""
+        try:
+            self._m_tenant_tokens.labels(tenant=tenant).inc(float(n_tokens))
+            gp = (gen_info or {}).get("goodput") or {}
+            chip_ms = float(gp.get("chip_ms", 0.0) or 0.0)
+            if chip_ms > 0:
+                self._m_tenant_chip.labels(tenant=tenant).inc(chip_ms / 1e3)
+            cost = float(gp.get("cost_usd", 0.0) or 0.0)
+            if cost > 0:
+                self._m_tenant_cost.labels(tenant=tenant).inc(cost)
+        except Exception:  # noqa: BLE001 — attribution must not fail serving
+            logger.exception("tenant rollup failed")
+
+    def _tenant_ledger_rollups(self) -> Dict[str, Dict[str, float]]:
+        """Merged per-tenant ledger rollups over the serving engines (the
+        live half of ``GET /debug/tenants``; additive keys sum, the
+        goodput fraction is recomputed after the merge)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for e in self._engines().values():
+            led = getattr(e, "ledger", None)
+            ts = getattr(led, "tenant_state", None)
+            if ts is None:
+                continue
+            for t, row in ts().items():
+                dst = out.setdefault(t, {})
+                for k, v in row.items():
+                    if k != "goodput_frac":
+                        dst[k] = dst.get(k, 0.0) + float(v)
+        for row in out.values():
+            row["goodput_frac"] = round(
+                min(1.0, row.get("useful_s", 0.0)
+                    / max(row.get("chip_s", 0.0), 1e-30)), 6
+            )
+        return out
+
+    def tenant_report(self) -> Dict:
+        """The per-tenant cost/usage picture ``GET /debug/tenants``
+        serves. The ``report`` half folds the flight journal through
+        obs/tenants.py — the SAME stdlib-only module
+        ``scripts/flightview.py --tenants`` loads by file path over an
+        exported journal, so the two render byte-identical reports over
+        the same events. ``tracker``/``ledger``/``slo`` carry live-only
+        facts (the interning table, in-memory rollups, burn rates) the
+        journal deliberately does not."""
+        report = obs_tenants.render_report(
+            obs_tenants.state_from_events(self.flight.snapshot()),
+            chip_hour_usd=self._goodput_price(),
+        )
+        self.slo.set_tenants(self.tenant_tracker.tracked())
+        return {
+            "enabled": self.tenants_enabled,
+            "report": report,
+            "tracker": self.tenant_tracker.snapshot(),
+            "ledger": self._tenant_ledger_rollups(),
+            "slo": self.slo.evaluate().get("tenants", {}),
+        }
 
     def _batch_occupancy(self) -> float:
         """Continuous mode: active device slots; coalescing mode: the size
@@ -1645,7 +1806,7 @@ class RagService:
 
     def answer(
         self, user_prompt: str, deadline: Optional[Deadline] = None,
-        session_id: Optional[str] = None,
+        session_id: Optional[str] = None, tenant: Optional[str] = None,
     ) -> Dict:
         timings: Dict[str, float] = {}
         notes: List[str] = []  # degraded-path reasons (response + counter)
@@ -1759,7 +1920,8 @@ class RagService:
                     self._inflight_generate -= 1
                 in_generate = False
                 resp = self._answer_fused(
-                    user_prompt, fused_r, timings, t_all, notes, deadline
+                    user_prompt, fused_r, timings, t_all, notes, deadline,
+                    tenant=tenant,
                 )
                 if resp is not None:
                     return self._finish(resp, notes)
@@ -1804,7 +1966,8 @@ class RagService:
                     self._inflight_generate -= 1
                 in_generate = False
                 resp = self._answer_prefixed(
-                    user_prompt, results, timings, t_all, notes
+                    user_prompt, results, timings, t_all, notes,
+                    tenant=tenant,
                 )
                 if resp is not None:
                     return self._finish(resp, notes)
@@ -1835,7 +1998,8 @@ class RagService:
                     )
                     try:
                         out_ids = self.scheduler.submit(
-                            prompt_ids, deadline=deadline, info=gen_info
+                            prompt_ids, deadline=deadline, info=gen_info,
+                            tenant=tenant,
                         )
                     except DeadlineExceeded as e:
                         # worker-side expiries (queue wait, mid-decode
@@ -1894,10 +2058,13 @@ class RagService:
         self.metrics.observe("query_seconds", timings["total_ms"] / 1e3)
         self.metrics.inc("query_decode_tokens", len(out_ids))
         self._observe_request(timings)
+        if tenant is not None:
+            self._tenant_complete(tenant, gen_info, len(out_ids))
         # shadow quality audit (sampled): the delivered stream vs the
         # exact path — the prompt is the exact token list that served
         self._shadow_observe(
-            served_engine, out_ids, gen_info, prompt_ids=prompt_ids
+            served_engine, out_ids, gen_info, prompt_ids=prompt_ids,
+            tenant=tenant,
         )
         resp = {
             "generated_text": extract_answer(completion),
@@ -1948,7 +2115,8 @@ class RagService:
             logger.exception("prefix segment warmup failed")
 
     def _answer_prefixed(self, user_prompt: str, results, timings, t_all,
-                         notes: Optional[List[str]] = None):
+                         notes: Optional[List[str]] = None,
+                         tenant: Optional[str] = None):
         """The KV-prefix-cache tail of ``answer()``: resolve the canonical
         segments against the device-resident cache (misses build + populate
         as they go), splice the matched prefix into a fresh request cache
@@ -2018,6 +2186,8 @@ class RagService:
         self.metrics.inc("query_decode_tokens", len(out_ids))
         self.metrics.inc("query_prefix_cached", 1)
         self._observe_request(timings)
+        if tenant is not None:
+            self._tenant_complete(tenant, gen_info, len(out_ids))
         # shadow quality audit: the prompt as served is the segment chain
         # + tail, and the resolve's CachedPrefix carries the fingerprint
         # (prefix_reuse / warm_tier / splice / rerotate / boundary_fixup)
@@ -2025,7 +2195,7 @@ class RagService:
         self._shadow_observe(
             self.engine, out_ids, gen_info,
             prompt_ids=[t for _, seg in segments for t in seg] + list(b_ids),
-            cp=cp,
+            cp=cp, tenant=tenant,
         )
         return {
             "generated_text": extract_answer(completion),
@@ -2035,7 +2205,8 @@ class RagService:
 
     def _answer_fused(self, user_prompt: str, fused_r, timings, t_all,
                       notes: Optional[List[str]] = None,
-                      deadline: Optional[Deadline] = None):
+                      deadline: Optional[Deadline] = None,
+                      tenant: Optional[str] = None):
         """The single-fetch tail of ``answer()``: device-side prompt assembly
         + generate from the unfetched retrieve handle (engine.generate_rag),
         with the ids fetch for the response's context text overlapped with
@@ -2137,6 +2308,8 @@ class RagService:
         self.metrics.inc("query_decode_tokens", len(out_ids))
         self.metrics.inc("query_single_fetch", 1)
         self._observe_request(timings)
+        if tenant is not None:
+            self._tenant_complete(tenant, gen_info, len(out_ids))
         # shadow quality audit: the prompt was assembled ON DEVICE, so
         # its token ids are reconstructed from the host mirror (pinned
         # token-identical to the device assembly) — and only when the
@@ -2148,6 +2321,7 @@ class RagService:
                 (self._piecewise_prompt(user_prompt, results) or (None, None)
                  )[1]
             ),
+            tenant=tenant,
         )
         return {
             "generated_text": extract_answer(completion),
@@ -2450,6 +2624,8 @@ class WsgiApp:
                      methods=["GET"]),
                 Rule("/debug/quality", endpoint="debug_quality",
                      methods=["GET"]),
+                Rule("/debug/tenants", endpoint="debug_tenants",
+                     methods=["GET"]),
             ]
         )
         # background xprof capture state (/profile {"seconds": N})
@@ -2541,12 +2717,24 @@ class WsgiApp:
         trace_id, span_id = tr.trace_id, tr.span_id
         la = self.service.lookahead
         launched_fut = None
+        tenant = None
         try:
             data = request.get_json(force=True, silent=True) or {}
             user_prompt = data.get("prompt", "")
             session_id = data.get("session_id")
             if session_id is not None:
                 session_id = str(session_id)
+            # tenant attribution (ISSUE 18): body field wins, then the
+            # x-tenant-id header, then "anon" — and the raw id is interned
+            # through the cardinality-bounded tracker HERE, so everything
+            # downstream (admission, journal, ledger, shadow, metrics)
+            # only ever sees a tracked value or __other__
+            if self.service.tenants_enabled:
+                raw = data.get("tenant_id") \
+                    or request.headers.get("x-tenant-id") \
+                    or obs_tenants.DEFAULT_TENANT
+                tenant = self.service.tenant_tracker.intern(str(raw))
+                tr.attrs["tenant"] = tenant
             logger.debug("User query: %s", user_prompt)
             tr.attrs["prompt"] = user_prompt[:80]
             deadline, dl_err = self._request_deadline(data, request.headers)
@@ -2569,9 +2757,11 @@ class WsgiApp:
                 # the admission gate fronts the WHOLE pipeline (both engine
                 # modes): over-cap traffic sheds here in microseconds with
                 # 429/503 + Retry-After instead of queueing unboundedly
-                with self.service.admission.admit(deadline=deadline):
+                with self.service.admission.admit(
+                        deadline=deadline, tenant=tenant):
                     body = self.service.answer(
-                        user_prompt, deadline=deadline, session_id=session_id
+                        user_prompt, deadline=deadline,
+                        session_id=session_id, tenant=tenant,
                     )
                 # access line while the trace is still current (formatter
                 # stamps trace_id/span_id from the contextvar)
@@ -2648,7 +2838,10 @@ class WsgiApp:
         resp.headers["traceparent"] = obs_logging.format_traceparent(
             trace_id, span_id
         )
-        self.service.observe_http(route, status)
+        self.service.observe_http(
+            route, status, tenant=tenant,
+            duration_s=time.monotonic() - t0,
+        )
         return resp
 
     def ep_index_info(self, request):
@@ -2714,6 +2907,12 @@ class WsgiApp:
         operator pages on and the numbers a dashboard plots cannot diverge.
         ``?force=1`` bypasses the short evaluation cache."""
         try:
+            # per-tenant burn (ISSUE 18): reconcile the spec set against
+            # the tracked tenants before evaluating, so the report's
+            # "tenants" section covers exactly the tracker's current top-K
+            self.service.slo.set_tenants(
+                self.service.tenant_tracker.tracked()
+            )
             report = self.service.slo.evaluate(
                 force=bool(request.args.get("force"))
             )
@@ -2807,6 +3006,22 @@ class WsgiApp:
             return self._jsonify(self.service.quality_report())
         except Exception as e:  # noqa: BLE001
             logger.exception("quality report failed")
+            return self._jsonify({"error": str(e)}, 500)
+
+    def ep_debug_tenants(self, request):
+        """The per-tenant cost/usage/quality report (obs/tenants.py,
+        docs/OBSERVABILITY.md "Tenant attribution"): journal-derived
+        per-tenant arrivals/completions/sheds/tokens/chip-seconds/cost
+        plus the live tracker table, ledger rollups and per-tenant SLO
+        burn. Same 403-unless-armed contract as every ``/debug`` route;
+        ``scripts/flightview.py --tenants`` rebuilds the report half
+        byte-identically from an exported journal."""
+        if not self._debug_enabled():
+            return self._debug_forbidden()
+        try:
+            return self._jsonify(self.service.tenant_report())
+        except Exception as e:  # noqa: BLE001
+            logger.exception("tenant report failed")
             return self._jsonify({"error": str(e)}, 500)
 
     def ep_debug_faults(self, request):

@@ -282,9 +282,10 @@ class _Req:
     — lockstep has no other thread to signal)."""
 
     __slots__ = ("rid", "prompt", "max_new", "seed", "emitted",
-                 "retries_left", "retried", "resumed")
+                 "retries_left", "retried", "resumed", "tenant")
 
-    def __init__(self, rid, prompt, max_new, seed, retries_left):
+    def __init__(self, rid, prompt, max_new, seed, retries_left,
+                 tenant=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.max_new = int(max_new)
@@ -293,6 +294,7 @@ class _Req:
         self.retries_left = int(retries_left)
         self.retried = False
         self.resumed = False
+        self.tenant = tenant
 
 
 def _arrival_prompt(a: Dict) -> List[int]:
@@ -353,12 +355,22 @@ class LockstepDriver:
                 req = _Req(
                     a.get("rid"), _arrival_prompt(a),
                     a.get("max_new", 1), a.get("seed"), self.retries,
+                    tenant=a.get("tenant"),
                 )
                 arr = {"prompt_len": len(req.prompt), "max_new": req.max_new}
                 if req.seed is not None:
                     arr["seed"] = req.seed
                 if "deadline_ms" in a:
                     arr["deadline_ms"] = a["deadline_ms"]
+                if req.tenant is not None:
+                    # forward the trace's tenant into the live submit: the
+                    # re-driven journal prices per tenant exactly like the
+                    # recording (and the engine's ledger rolls it up)
+                    arr["tenant"] = req.tenant
+                    ledger = getattr(eng, "ledger", None)
+                    note = getattr(ledger, "note_tenant", None)
+                    if note is not None:
+                        note(req.rid, req.tenant)
                 if self.arrival_ids:
                     arr["ids"] = list(req.prompt)
                 self.emit("arrival", req.rid, **arr)
@@ -514,6 +526,8 @@ class LockstepDriver:
         pop_spec = getattr(eng, "pop_spec_seen", None)
         if pop_spec:
             pop_spec(it.rid)
+        if it.tenant is not None:
+            extra["tenant"] = it.tenant
         self.emit(
             "complete", it.rid, n_tokens=len(result),
             stream_fnv=_flight.stream_hash(result), **extra,

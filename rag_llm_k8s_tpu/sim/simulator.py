@@ -57,6 +57,7 @@ def _load_sibling(name: str):
 
 policy = _load_sibling("policy")
 _goodput = policy.load_sibling("../obs/goodput")
+_tenants = policy.load_sibling("../obs/tenants")
 
 
 class PoolExhausted(RuntimeError):
@@ -350,8 +351,10 @@ class SimEngine:
             ))
             for j, rid, p, mx, row in admitted:
                 tok0 = self._tok(rid, 0)
+                tn = self.ledger.tenant_of(rid)
                 self.emit("admit", rid, slot=row, prompt_len=len(p),
-                          bucket=S, tok0=tok0)
+                          bucket=S, tok0=tok0,
+                          **({"tenant": tn} if tn else {}))
                 target = mx
                 if rid in self.out_len:  # recorded generation length
                     target = max(1, min(mx, int(self.out_len[rid])))
@@ -459,8 +462,9 @@ class SimEngine:
     def discard_request_goodput(self, rid: int) -> None:
         self.ledger.discard_request(rid)
 
-    def pop_request_goodput(self, rid: int) -> Optional[Dict]:
-        return self.ledger.pop_request(rid)
+    def pop_request_goodput(self, rid: int,
+                            tokens: float = 0.0) -> Optional[Dict]:
+        return self.ledger.pop_request(rid, tokens=tokens)
 
     def pop_blocks_allocated(self, rid: int) -> Optional[int]:
         return self._blocks_at_retire.pop(rid, None)
@@ -513,4 +517,10 @@ def simulate(trace, engine: Optional[SimEngine] = None, retries: int = 1,
         "steps_per_s": round(eng.decode_steps / virtual_s, 4),
         "tokens_out": sum(len(v) for v in results.values()),
         "report": _goodput.render_report(state, eng.chip_hour_usd),
+        # per-tenant cost split (tracegen traces carry tenant mixes): the
+        # SAME renderer /debug/tenants and flightview --tenants use, so
+        # "which tenant pays for the next replica" is answerable offline
+        "tenant_report": _tenants.render_report(
+            _tenants.state_from_events(eng.journal), eng.chip_hour_usd
+        ),
     }

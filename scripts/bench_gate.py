@@ -95,6 +95,11 @@ REQUIRED_KEYS = (
     # dropped leg must fail the gate, not read as "capacity-planning
     # predictions unjudged" (docs/REPLAY.md)
     "replay_fidelity.steps_per_s_ratio",
+    # ISSUE 18: tenant attribution's measured cost (full per-request
+    # lifecycle — edge intern, stamp, fold, counter pushes — on vs off at
+    # B=8 continuous decode; acceptance ≤ 2%) — attribution is ON by
+    # default, so its overhead may never go unjudged in a bench round
+    "tenant_overhead.overhead_frac",
 )
 
 

@@ -20,6 +20,11 @@ copied off a pod's spool directory) — or a bare journal dump — into:
   per-approximation attribution, rebuilt from the journal's
   ``shadow_audit`` events by the SAME renderer ``GET /debug/quality``
   uses live (rag_llm_k8s_tpu/obs/shadow.py, same jax-free contract);
+- **the tenant attribution report** (``--tenants``): per-tenant
+  arrivals/completions/sheds/tokens/chip-seconds/cost and shadow-audit
+  divergence, rebuilt from the journal's tenant-stamped lifecycle events
+  by the SAME renderer ``GET /debug/tenants`` uses live
+  (rag_llm_k8s_tpu/obs/tenants.py, same jax-free contract);
 - **the replay diff** (``--replay-diff OTHER``): event-by-event
   comparison of two journals' scheduler decision streams — the first
   divergent decision, per-event-type count deltas, occupancy deltas —
@@ -36,6 +41,7 @@ Usage:
     python scripts/flightview.py BUNDLE.json --request 7
     python scripts/flightview.py BUNDLE.json --goodput [--chip-hour-usd X]
     python scripts/flightview.py BUNDLE.json --quality
+    python scripts/flightview.py BUNDLE.json --tenants [--chip-hour-usd X]
     python scripts/flightview.py RECORDED.json --replay-diff REPLAYED.json
 
 Input shapes accepted: a full incident bundle (``{"journal": [...],
@@ -302,6 +308,47 @@ def build_quality_report(events: List[Dict]) -> Dict:
     return sh.render_report(sh.state_from_events(events))
 
 
+def build_tenant_report(events: List[Dict],
+                        chip_hour_usd: float = 0.0) -> Dict:
+    """The offline half of the tenant same-report contract: fold the
+    journal's arrival/admit/complete/shed/shadow_audit events through the
+    exact renderer ``GET /debug/tenants`` serves live (obs/tenants.py,
+    stdlib-only by contract) — the two reports are byte-identical over
+    the same events."""
+    tn = _load_obs_module("tenants")
+    return tn.render_report(
+        tn.state_from_events(events), chip_hour_usd=chip_hour_usd
+    )
+
+
+def render_tenant_ascii(report: Dict) -> str:
+    tot = report["totals"]
+    lines = [
+        "tenant attribution report",
+        f"  events={report['events']}  wall={report['wall_s']:.3f}s"
+        f"  tenants={tot['tenants']}",
+        f"  totals: arrivals={tot['arrivals']}  admitted={tot['admitted']}"
+        f"  completed={tot['completed']}  sheds={tot['sheds']}"
+        f"  tokens={tot['tokens']}  chip_s={tot['chip_s']:.4f}"
+        f"  cost_usd={tot['cost_usd']:.6f}",
+        "  per tenant (sorted by chip-seconds):",
+    ]
+    for row in report["tenants"]:
+        lines.append(
+            f"    {row['tenant']:<16} arr={row['arrivals']:<5}"
+            f" done={row['completed']:<5} shed={row['sheds']:<4}"
+            f" tokens={row['tokens']:<7} chip_s={row['chip_s']:<10.4f}"
+            f" share={row['chip_share']:.4f}"
+            f" cost={row['cost_usd']:.6f}"
+            f" tok/chip_s={row['tokens_per_chip_s']}"
+        )
+        if row["audits"]:
+            lines.append(
+                f"      audits={row['audits']}  diverged={row['diverged']}"
+            )
+    return "\n".join(lines)
+
+
 def render_quality_ascii(report: Dict) -> str:
     a = report["audits"]
     lines = [
@@ -389,9 +436,14 @@ def main(argv=None) -> int:
                     help="render the shadow-audit quality report rebuilt "
                          "from the journal's shadow_audit events instead "
                          "of the lifecycle view")
+    ap.add_argument("--tenants", action="store_true",
+                    help="render the per-tenant attribution report rebuilt "
+                         "from the journal's arrival/complete/shed/"
+                         "shadow_audit events instead of the lifecycle view")
     ap.add_argument("--chip-hour-usd", type=float, default=0.0,
-                    help="chip rental price for the --goodput cost figures "
-                         "(defaults to 0: attribution only, no dollars)")
+                    help="chip rental price for the --goodput/--tenants "
+                         "cost figures (defaults to 0: attribution only, "
+                         "no dollars)")
     ap.add_argument("--replay-diff", metavar="OTHER", default=None,
                     help="compare BUNDLE's scheduler decision stream "
                          "against OTHER's (a replayed or simulated "
@@ -427,6 +479,15 @@ def main(argv=None) -> int:
             print(json.dumps(report, indent=1))
         else:
             print(render_quality_ascii(report))
+        return 0
+    if args.tenants:
+        report = build_tenant_report(
+            events, chip_hour_usd=args.chip_hour_usd
+        )
+        if args.as_json:
+            print(json.dumps(report, indent=1))
+        else:
+            print(render_tenant_ascii(report))
         return 0
     if args.goodput:
         report = build_goodput_report(

@@ -36,6 +36,7 @@ PURE_OBS = (
     f"{PACKAGE}/obs/flight.py",
     f"{PACKAGE}/obs/goodput.py",
     f"{PACKAGE}/obs/shadow.py",
+    f"{PACKAGE}/obs/tenants.py",
 )
 
 #: stdlib fallback for interpreters predating sys.stdlib_module_names —

@@ -1,0 +1,718 @@
+"""Tenant-dimensional observability (ISSUE 18, docs/OBSERVABILITY.md
+"Tenant attribution").
+
+- **Cardinality is bounded by construction**: the TenantTracker interns
+  every raw tenant id before it may become a label value — a 10k-unique-id
+  churn storm leaves at most ``top_k`` tracked names + ``__other__`` on
+  every bound family, demotions prune synchronously, a cold tenant that
+  turns hot re-promotes, and concurrent interns racing a scrape-side
+  prune never break the bound.
+- **Conservation**: a 3-tenant workload through the paged continuous
+  scheduler books per-tenant chip-seconds whose sum tracks the
+  scheduler's independently measured busy time within 5% — attribution
+  adds a dimension, never invents or loses chip time.
+- **Same report, two sources**: ``GET /debug/tenants`` (live journal
+  snapshot) and ``scripts/flightview.py --tenants`` (offline journal)
+  render through the SAME stdlib-only module (obs/tenants.py) and are
+  byte-identical — proven with the offline half run in a subprocess
+  whose ``jax`` import is poisoned.
+- **Prometheus HELP escaping**: backslash + newline only, per the text
+  exposition spec — a multi-line help string must never split a comment
+  into a line the scraper rejects.
+- **Replay**: the trace record preserves ``tenant`` and the lockstep
+  driver forwards it into its re-driven submits — a re-driven journal
+  prices per tenant exactly like the recording.
+
+``make tenants-smoke`` runs TestTenantsSmoke; the full matrix runs under
+tier1.
+"""
+
+import json
+import re
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import jax
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from rag_llm_k8s_tpu.core.config import (
+    AppConfig,
+    DTypePolicy,
+    EncoderConfig,
+    EngineConfig,
+    FlightConfig,
+    LlamaConfig,
+    SamplingConfig,
+    TenantConfig,
+)
+from rag_llm_k8s_tpu.engine.continuous import ContinuousEngine, ContinuousScheduler
+from rag_llm_k8s_tpu.engine.encoder import EncoderRunner
+from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+from rag_llm_k8s_tpu.index.store import VectorStore
+from rag_llm_k8s_tpu.models.bge_m3 import init_encoder_params
+from rag_llm_k8s_tpu.models.llama import init_llama_params
+from rag_llm_k8s_tpu.obs import flight
+from rag_llm_k8s_tpu.obs import metrics as obs_metrics
+from rag_llm_k8s_tpu.obs import tenants as obs_tenants
+from rag_llm_k8s_tpu.sim import replay
+from rag_llm_k8s_tpu.server.app import RagService, create_app
+
+from scripts import flightview  # noqa: E402
+
+FP32 = DTypePolicy.fp32()
+GREEDY = SamplingConfig(do_sample=False, max_new_tokens=8)
+# sync=4 mirrors test_goodput's conservation config: real window shapes
+# amortize the ledger's per-step bookkeeping so the 5% bound judges
+# attribution, not degenerate sub-ms windows
+PAGED = EngineConfig(
+    prompt_buckets=(16, 32), max_batch_size=4, max_seq_len=128,
+    kv_paged=True, kv_block_size=16, decode_sync_steps=4,
+)
+OTHER = obs_metrics.TenantTracker.OTHER
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    params = init_llama_params(jax.random.PRNGKey(0), cfg, FP32)
+    return cfg, params
+
+
+def _tenant_children(fam):
+    """The distinct ``tenant`` label values currently held by a family."""
+    return {dict(labels).get("tenant") for labels, _ in fam.items()}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus HELP escaping (exposition grammar)
+# ---------------------------------------------------------------------------
+class TestHelpEscaping:
+    def test_backslash_and_newline_escaped_quotes_literal(self):
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter(
+            "rag_esc_total",
+            'line one\nline two with \\ backslash and "quotes"',
+        ).inc()
+        text = reg.render_prometheus()
+        helps = [
+            ln for ln in text.splitlines()
+            if ln.startswith("# HELP rag_esc_total")
+        ]
+        # ONLY backslash and newline escape in HELP (the spec); quotes
+        # stay literal — label-value escaping must not leak in here
+        assert helps == [
+            "# HELP rag_esc_total line one\\nline two with "
+            '\\\\ backslash and "quotes"'
+        ]
+
+    def test_exposition_grammar_holds_with_hostile_help(self):
+        """Every line of an exposition carrying newline/backslash help is
+        a well-formed comment or sample — nothing splits mid-line."""
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("rag_g1_total", "a\nb").inc(2)
+        reg.gauge("rag_g2", "c\\d").inc(1)
+        fam = reg.labeled_histogram("rag_g3_seconds", "e\nf\\g",
+                                    buckets=(0.1, 1.0))
+        fam.labels(tenant="t").observe(0.5)
+        comment = re.compile(r"^# (HELP|TYPE) [A-Za-z_:][A-Za-z0-9_:]* .+$")
+        sample = re.compile(
+            r"^[A-Za-z_:][A-Za-z0-9_:]*(\{[^{}\n]*\})? "
+            r"(-?[0-9][0-9eE.+-]*|[+-]Inf|nan)$"
+        )
+        for ln in reg.render_prometheus().splitlines():
+            if not ln:
+                continue
+            assert comment.match(ln) or sample.match(ln), ln
+
+
+# ---------------------------------------------------------------------------
+# the cardinality-bounded tracker
+# ---------------------------------------------------------------------------
+class TestTenantTracker:
+    def test_hot_tenants_keep_names_cold_fold_to_other(self):
+        trk = obs_metrics.TenantTracker(top_k=2)
+        for _ in range(5):
+            assert trk.intern("a") == "a"
+        for _ in range(3):
+            assert trk.intern("b") == "b"
+        # the third distinct tenant can't displace a (5) or b (3) at count 1
+        assert trk.intern("c") == OTHER
+        assert trk.tracked() == ("a", "b")
+
+    def test_cold_tenant_repromotes_when_it_turns_hot(self):
+        trk = obs_metrics.TenantTracker(top_k=2)
+        for _ in range(10):
+            trk.intern("a")
+        for _ in range(5):
+            trk.intern("b")
+        # c rides __other__ until its count STRICTLY passes the tracked
+        # minimum (ties keep the incumbent — no exposition flapping)
+        outs = [trk.intern("c") for _ in range(6)]
+        assert outs[:-1] == [OTHER] * 5
+        assert outs[-1] == "c"
+        assert trk.tracked() == ("a", "c")
+
+    def test_other_can_never_be_impersonated(self):
+        trk = obs_metrics.TenantTracker(top_k=2)
+        for _ in range(50):
+            assert trk.intern(OTHER) == OTHER
+        assert trk.tracked() == ()
+
+    def test_churn_storm_bound_on_bound_family(self):
+        """10k unique ids against K=4: the bound family ends with at most
+        K tracked children + __other__, and every intern returned either
+        a currently-tracked name or __other__."""
+        reg = obs_metrics.MetricsRegistry()
+        fam = reg.labeled_counter("rag_tenant_storm_total", "churn")
+        trk = obs_metrics.TenantTracker(top_k=4)
+        trk.bind(fam)
+        hot = [f"team-{i}" for i in range(4)]
+        for name in hot:
+            for _ in range(100):
+                fam.labels(tenant=trk.intern(name)).inc()
+        for i in range(10_000):
+            label = trk.intern(f"drive-by-{i}")
+            fam.labels(tenant=label).inc()
+        trk.prune()
+        assert set(trk.tracked()) == set(hot)
+        children = _tenant_children(fam)
+        assert len(children) <= trk.top_k + 1
+        assert children <= set(hot) | {OTHER}
+        snap = trk.snapshot()
+        assert snap["table_size"] <= trk.capacity
+        assert snap["tracked"] == sorted(hot)
+
+    def test_demotion_prunes_bound_family_synchronously(self):
+        reg = obs_metrics.MetricsRegistry()
+        fam = reg.labeled_counter("rag_tenant_demote_total", "demote")
+        trk = obs_metrics.TenantTracker(top_k=1)
+        trk.bind(fam)
+        fam.labels(tenant=trk.intern("a")).inc()
+        assert "a" in _tenant_children(fam)
+        # b overtakes a: the demotion prunes a's series inside intern()
+        for _ in range(3):
+            label = trk.intern("b")
+            fam.labels(tenant=label).inc()
+        assert trk.tracked() == ("b",)
+        children = _tenant_children(fam)
+        assert "a" not in children
+        assert children <= {"b", OTHER}
+
+    def test_concurrent_interns_racing_scrape_prune_keep_bound(self):
+        """Worker threads intern churning ids while a scrape thread
+        prunes/snapshots — no exceptions, and the final pruned family
+        holds at most K+1 tenant children."""
+        reg = obs_metrics.MetricsRegistry()
+        fam = reg.labeled_counter("rag_tenant_race_total", "race")
+        trk = obs_metrics.TenantTracker(top_k=4)
+        trk.bind(fam)
+        errs = []
+        stop = threading.Event()
+
+        def worker(base):
+            try:
+                for i in range(2000):
+                    name = f"w{base}-{i % (5 + base)}"
+                    fam.labels(tenant=trk.intern(name)).inc()
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        def scraper():
+            try:
+                while not stop.is_set():
+                    trk.prune()
+                    trk.snapshot()
+                    reg.render_prometheus()
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(b,))
+                   for b in range(4)]
+        st = threading.Thread(target=scraper)
+        st.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        stop.set()
+        st.join(timeout=60)
+        assert not errs
+        trk.prune()
+        assert len(_tenant_children(fam)) <= trk.top_k + 1
+
+    def test_invalid_construction_raises(self):
+        with pytest.raises(ValueError):
+            obs_metrics.TenantTracker(top_k=0)
+        with pytest.raises(ValueError):
+            obs_metrics.TenantTracker(top_k=8, capacity=4)
+
+
+# ---------------------------------------------------------------------------
+# the pure renderer (obs/tenants.py)
+# ---------------------------------------------------------------------------
+class TestTenantsReport:
+    def _events(self):
+        return [
+            {"seq": 1, "t": 10.0, "type": "arrival", "rid": 1,
+             "tenant": "a", "prompt_len": 4, "max_new": 8},
+            {"seq": 2, "t": 10.1, "type": "admit", "rid": 1, "slot": 0},
+            {"seq": 3, "t": 10.2, "type": "sync_window_open", "steps": 4},
+            {"seq": 4, "t": 10.5, "type": "complete", "rid": 1,
+             "n_tokens": 5, "chip_ms": 2000.0, "cost_usd": 0.01},
+            {"seq": 5, "t": 10.6, "type": "shed", "tenant": "b",
+             "reason": "queue_full", "status": 429},
+            {"seq": 6, "t": 10.7, "type": "shadow_audit", "rid": 1,
+             "outcome": "diverged", "n": 5},
+        ]
+
+    def test_rid_resolution_and_row_figures(self):
+        rep = obs_tenants.render_report(
+            obs_tenants.state_from_events(self._events())
+        )
+        rows = {r["tenant"]: r for r in rep["tenants"]}
+        a = rows["a"]
+        # admit/complete/shadow_audit carried only rid — the arrival's
+        # tenant seeds the rid map everything later resolves through
+        assert a["arrivals"] == 1 and a["admitted"] == 1
+        assert a["completed"] == 1 and a["tokens"] == 5
+        assert a["chip_s"] == pytest.approx(2.0)
+        assert a["cost_usd"] == pytest.approx(0.01)
+        assert a["audits"] == 1 and a["diverged"] == 1
+        assert a["chip_share"] == pytest.approx(1.0)
+        assert rows["b"]["sheds"] == 1
+        assert rep["totals"]["tenants"] == 2
+        assert rep["totals"]["chip_s"] == pytest.approx(2.0)
+        assert rep["wall_s"] == pytest.approx(0.7)
+
+    def test_untagged_events_fold_to_anon(self):
+        evs = [
+            {"seq": 1, "t": 0.0, "type": "arrival", "rid": 7,
+             "prompt_len": 2, "max_new": 4},
+            {"seq": 2, "t": 0.1, "type": "complete", "rid": 7,
+             "n_tokens": 4, "chip_ms": 100.0},
+        ]
+        rep = obs_tenants.render_report(obs_tenants.state_from_events(evs))
+        assert [r["tenant"] for r in rep["tenants"]] == ["anon"]
+        assert rep["tenants"][0]["completed"] == 1
+
+    def test_cost_derived_from_chip_seconds_when_unpriced(self):
+        evs = [
+            {"seq": 1, "t": 0.0, "type": "arrival", "rid": 1, "tenant": "a"},
+            {"seq": 2, "t": 0.1, "type": "complete", "rid": 1,
+             "n_tokens": 3, "chip_ms": 1800.0},
+        ]
+        rep = obs_tenants.render_report(
+            obs_tenants.state_from_events(evs), chip_hour_usd=3600.0
+        )
+        assert rep["tenants"][0]["cost_usd"] == pytest.approx(1.8)
+        assert rep["totals"]["cost_usd"] == pytest.approx(1.8)
+
+    def test_rows_sorted_by_chip_then_name(self):
+        evs = []
+        for i, (tn, ms) in enumerate(
+            [("x", 100.0), ("y", 300.0), ("w", 100.0)]
+        ):
+            evs.append({"seq": 2 * i, "t": float(i), "type": "arrival",
+                        "rid": i, "tenant": tn})
+            evs.append({"seq": 2 * i + 1, "t": float(i), "type": "complete",
+                        "rid": i, "n_tokens": 1, "chip_ms": ms})
+        rep = obs_tenants.render_report(obs_tenants.state_from_events(evs))
+        assert [r["tenant"] for r in rep["tenants"]] == ["y", "w", "x"]
+
+
+# ---------------------------------------------------------------------------
+# config round-trip
+# ---------------------------------------------------------------------------
+class TestTenantConfig:
+    def test_defaults_on(self):
+        cfg = AppConfig.from_env({})
+        assert cfg.tenants.enabled is True
+        assert cfg.tenants.top_k == 8
+
+    def test_env_round_trip(self):
+        cfg = AppConfig.from_env(
+            {"TPU_RAG_TENANTS": "0", "TPU_RAG_TENANT_TOP_K": "3"}
+        )
+        assert cfg.tenants.enabled is False
+        assert cfg.tenants.top_k == 3
+
+    @pytest.mark.parametrize("env", [
+        {"TPU_RAG_TENANT_TOP_K": "0"},
+        {"TPU_RAG_TENANT_TOP_K": "nope"},
+        {"TPU_RAG_TENANTS": "maybe"},
+    ])
+    def test_invalid_values_raise(self, env):
+        with pytest.raises(ValueError):
+            AppConfig.from_env(env)
+
+
+# ---------------------------------------------------------------------------
+# replay: the trace record carries tenant end-to-end
+# ---------------------------------------------------------------------------
+class TestReplayTenant:
+    def test_lockstep_round_trip_preserves_tenant(self, tiny):
+        """Record a tenant-stamped lockstep run, extract its trace,
+        re-drive it: arrivals AND admits stay tenant-stamped both times,
+        and the re-extracted trace carries identical tenants."""
+        cfg, params = tiny
+        trace = {"arrivals": [
+            {"rid": 201 + i, "t_step": [0, 0, 1, 2][i],
+             "ids": [3 + i, 17, 42, 7 + i], "prompt_len": 4, "max_new": 6,
+             "seed": None, "tenant": ["a", "b", "a", OTHER][i]}
+            for i in range(4)
+        ]}
+
+        def drive(t):
+            eng = ContinuousEngine(
+                cfg, params, sampling=GREEDY, engine_config=PAGED,
+                dtypes=FP32,
+            )
+            flight.configure(enabled=True, capacity=8192)
+            flight.recorder().clear()
+            drv = replay.LockstepDriver(eng, emit=flight.emit)
+            drv.drive(t)
+            return flight.recorder().snapshot()
+
+        j1 = drive(trace)
+        t1 = replay.extract_trace(j1)
+        got = {a["rid"]: a.get("tenant") for a in t1["arrivals"]}
+        assert got == {201: "a", 202: "b", 203: "a", 204: OTHER}
+        admits = [e for e in j1 if e["type"] == "admit"]
+        assert admits and all(
+            e.get("tenant") == got[e["rid"]] for e in admits
+        )
+        j2 = drive(t1)
+        t2 = replay.extract_trace(j2)
+        assert [a.get("tenant") for a in t2["arrivals"]] \
+            == [a.get("tenant") for a in t1["arrivals"]]
+        # and the offline report books the same tenant set either way
+        r1 = obs_tenants.render_report(obs_tenants.state_from_events(j1))
+        r2 = obs_tenants.render_report(obs_tenants.state_from_events(j2))
+        assert [r["tenant"] for r in r1["tenants"]] \
+            == [r["tenant"] for r in r2["tenants"]]
+
+
+# ---------------------------------------------------------------------------
+# service edge: extraction, gating, exposition, SLO section
+# ---------------------------------------------------------------------------
+class TestTenantService:
+    def test_debug_tenants_gated_403_unless_armed(
+        self, tenant_service, monkeypatch
+    ):
+        monkeypatch.delenv("TPU_RAG_FAULTS", raising=False)
+        monkeypatch.delenv("TPU_RAG_DEBUG", raising=False)
+        client = create_app(tenant_service).test_client()
+        r = client.get("/debug/tenants")
+        assert r.status_code == 403
+        monkeypatch.setenv("TPU_RAG_FAULTS", "1")
+        client = create_app(tenant_service).test_client()
+        assert client.get("/debug/tenants").status_code == 200
+
+    def test_edge_extraction_body_then_header_then_anon(
+        self, tenant_service, monkeypatch
+    ):
+        monkeypatch.setenv("TPU_RAG_FAULTS", "1")
+        client = create_app(tenant_service).test_client()
+        r = client.post(
+            "/generate",
+            json={"prompt": "alpha", "tenant_id": "team-body"},
+            headers={"x-tenant-id": "team-header"},
+        )
+        assert r.status_code == 200
+        r = client.post(
+            "/generate", json={"prompt": "alpha"},
+            headers={"x-tenant-id": "team-header"},
+        )
+        assert r.status_code == 200
+        r = client.post("/generate", json={"prompt": "alpha"})
+        assert r.status_code == 200
+        rep = client.get("/debug/tenants").get_json()
+        assert rep["enabled"] is True
+        names = {row["tenant"] for row in rep["report"]["tenants"]}
+        # body field beat the header on the first request
+        assert {"team-body", "team-header", "anon"} <= names
+        assert all(
+            row["completed"] >= 1
+            for row in rep["report"]["tenants"]
+            if row["tenant"] in ("team-body", "team-header", "anon")
+        )
+        # the live halves ride alongside the journal-derived report
+        assert set(rep["tracker"]["counts"]) >= {"team-body", "team-header"}
+        assert "team-body" in rep["ledger"]
+        assert rep["ledger"]["team-body"]["chip_s"] > 0
+
+    def test_exposition_carries_bounded_tenant_families(
+        self, tenant_service, monkeypatch
+    ):
+        monkeypatch.setenv("TPU_RAG_FAULTS", "1")
+        client = create_app(tenant_service).test_client()
+        assert client.post(
+            "/generate", json={"prompt": "alpha", "tenant_id": "team-body"}
+        ).status_code == 200
+        text = client.get("/metrics").get_data(as_text=True)
+        assert re.search(
+            r'rag_tenant_http_requests_total\{[^}]*tenant="team-body"[^}]*\}',
+            text,
+        )
+        assert "rag_tenant_request_seconds_bucket" in text
+        assert "rag_tenant_chip_seconds_total" in text
+        assert "rag_tenant_tokens_total" in text
+        assert "rag_tenant_tracked" in text
+        vals = set(re.findall(r'\btenant="([^"]*)"', text))
+        trk = tenant_service.tenant_tracker
+        assert vals <= set(trk.tracked()) | {OTHER}
+        assert len(vals) <= trk.top_k + 1
+
+    def test_slo_report_carries_tenant_burn_section(
+        self, tenant_service, monkeypatch
+    ):
+        monkeypatch.setenv("TPU_RAG_FAULTS", "1")
+        client = create_app(tenant_service).test_client()
+        assert client.post(
+            "/generate", json={"prompt": "alpha", "tenant_id": "team-slo"}
+        ).status_code == 200
+        rep = client.get("/slo").get_json()
+        assert "tenants" in rep
+        assert "team-slo" in rep["tenants"]
+        entries = {e["name"]: e for e in rep["tenants"]["team-slo"]}
+        assert "tenant:team-slo:availability" in entries
+        assert "tenant:team-slo:request_p95" in entries
+        for e in entries.values():
+            assert "burn_rate" in e and "error_budget_remaining" in e
+
+    def test_disabled_edge_leaves_requests_unstamped(
+        self, tenant_service, monkeypatch
+    ):
+        monkeypatch.setenv("TPU_RAG_FAULTS", "1")
+        monkeypatch.setattr(tenant_service, "tenants_enabled", False)
+        before = tenant_service.tenant_tracker.snapshot()["table_size"]
+        client = create_app(tenant_service).test_client()
+        r = client.post(
+            "/generate",
+            json={"prompt": "alpha", "tenant_id": "team-disabled"},
+        )
+        assert r.status_code == 200
+        after = tenant_service.tenant_tracker.snapshot()["table_size"]
+        assert after == before  # the edge never interned anything
+        rep = client.get("/debug/tenants").get_json()
+        assert rep["enabled"] is False
+        names = {row["tenant"] for row in rep["report"]["tenants"]}
+        assert "team-disabled" not in names
+
+
+# ---------------------------------------------------------------------------
+# smoke (make tenants-smoke): bound, conservation, byte-identity
+# ---------------------------------------------------------------------------
+class TestTenantsSmoke:
+    def test_churn_storm_keeps_k_plus_other(self):
+        """The cardinality acceptance bound: 10k unique tenant ids leave
+        at most top_k tracked children + __other__ on a bound family."""
+        reg = obs_metrics.MetricsRegistry()
+        fam = reg.labeled_counter("rag_tenant_smoke_total", "smoke churn")
+        trk = obs_metrics.TenantTracker(top_k=8)
+        trk.bind(fam)
+        hot = [f"team-{i}" for i in range(8)]
+        # space-saving counts are overestimates: 10k evictions across a
+        # 128-slot table ratchet the inherited floor up by ~10k/128 ≈ 78,
+        # so the hot set needs counts clear of that climb to stay tracked
+        for name in hot:
+            for _ in range(200):
+                fam.labels(tenant=trk.intern(name)).inc()
+        for i in range(10_000):
+            fam.labels(tenant=trk.intern(f"storm-{i}")).inc()
+        trk.prune()
+        children = _tenant_children(fam)
+        assert len(children) <= trk.top_k + 1
+        assert set(trk.tracked()) == set(hot)
+
+    def test_three_tenant_conservation_through_paged_scheduler(self, tiny):
+        """THE conservation acceptance: three tenants' rollup chip-seconds
+        sum to the scheduler's independently measured busy time within
+        5% — attribution one dimension finer than the ledger, same
+        total."""
+        cfg, params = tiny
+        eng = ContinuousEngine(
+            cfg, params, sampling=GREEDY, engine_config=PAGED, dtypes=FP32
+        )
+        eng.warmup(batch_sizes=(4,))  # compiles out of the measured span
+        sched = ContinuousScheduler(eng)
+        prompts = [
+            [3, 17, 42, 7], [5, 5, 8], [11] * 12,
+            [2, 9], [4] * 20, [7, 8, 9, 10, 11, 12],
+        ]
+        tenants = ["a", "b", "c", "a", "b", "c"]
+        try:
+            outs = [None] * len(prompts)
+
+            def run(i):
+                outs[i] = sched.submit(
+                    prompts[i], timeout=120, tenant=tenants[i]
+                )
+
+            threads = [
+                threading.Thread(target=run, args=(i,))
+                for i in range(len(prompts))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert all(o is not None for o in outs)
+            rolls = eng.ledger.tenant_state()
+            assert set(rolls) == {"a", "b", "c"}
+            for r in rolls.values():
+                assert r["requests"] == 2
+                assert r["chip_s"] > 0
+                assert 0.0 < r["goodput_frac"] <= 1.0
+            total = sum(r["chip_s"] for r in rolls.values())
+            busy = sched.busy_seconds()
+            assert busy > 0
+            assert abs(total - busy) / busy < 0.05, (
+                f"per-tenant {total:.4f}s vs busy {busy:.4f}s"
+            )
+        finally:
+            sched.shutdown()
+
+    def test_debug_tenants_and_flightview_byte_identical_without_jax(
+        self, tenant_service, monkeypatch, tmp_path
+    ):
+        """The one-renderer acceptance: the /debug/tenants ``report`` half
+        and ``flightview --tenants`` over the exported journal serialize
+        byte-identically — with the offline half run in a subprocess
+        whose ``jax`` import is POISONED, proving the journal+stdlib
+        contract (no live pod, no jax, nothing but the bundle)."""
+        monkeypatch.setenv("TPU_RAG_FAULTS", "1")
+        client = create_app(tenant_service).test_client()
+        for tn in ("smoke-a", "smoke-b", "smoke-a"):
+            r = client.post(
+                "/generate", json={"prompt": "alpha", "tenant_id": tn}
+            )
+            assert r.status_code == 200
+        # the renderers are pure over the event list, so byte-identity
+        # needs both halves to see the SAME journal — wait out any async
+        # stragglers (shadow audits) until live report and exported
+        # bundle agree on event count (journal is append-only: equal
+        # length over the same recorder means equal events)
+        deadline = time.monotonic() + 30.0
+        while True:
+            live = client.get("/debug/tenants").get_json()["report"]
+            journal = tenant_service.flight.snapshot()
+            if live["events"] == len(journal):
+                break
+            assert time.monotonic() < deadline, (
+                f"journal never quiesced: report folded {live['events']} "
+                f"events, snapshot has {len(journal)}"
+            )
+            time.sleep(0.05)
+        bundle = {
+            "schema_version": flight.SCHEMA_VERSION,
+            "journal": journal,
+        }
+        path = tmp_path / "journal.json"
+        path.write_text(json.dumps(bundle))
+        assert {"smoke-a", "smoke-b"} <= {
+            r["tenant"] for r in live["tenants"]
+        }
+        # in-process first (the cheap half of the contract)...
+        offline = flightview.build_tenant_report(
+            flightview.load_events(bundle)
+        )
+        assert json.dumps(offline, sort_keys=True) \
+            == json.dumps(live, sort_keys=True)
+        # ...then the poisoned-import half: a jax.py that raises shadows
+        # the real package, so ANY jax import in the offline path crashes
+        poison = tmp_path / "poison"
+        poison.mkdir()
+        (poison / "jax.py").write_text(
+            'raise ImportError("poisoned: the offline tenant renderer '
+            'must not import jax")\n'
+        )
+        script = (
+            "import json, sys\n"
+            f"sys.path.insert(0, {str(poison)!r})\n"
+            f"sys.path.insert(0, {str(REPO_ROOT)!r})\n"
+            "from scripts import flightview\n"
+            f"bundle = json.loads(open({str(path)!r}).read())\n"
+            "rep = flightview.build_tenant_report("
+            "flightview.load_events(bundle))\n"
+            "sys.stdout.write(json.dumps(rep, sort_keys=True))\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert proc.stdout == json.dumps(live, sort_keys=True)
+        # the CLI renders both forms standalone
+        assert flightview.main([str(path), "--tenants", "--json"]) == 0
+        assert flightview.main([str(path), "--tenants"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# service fixture
+# ---------------------------------------------------------------------------
+class ByteTokenizer:
+    def encode(self, text):
+        return [b + 3 for b in text.encode("utf-8")]
+
+    def decode(self, ids, skip_special_tokens=True):
+        return bytes((i - 3) % 256 for i in ids if i >= 3).decode(
+            "utf-8", "replace"
+        )
+
+
+@pytest.fixture(scope="module")
+def tenant_service(tmp_path_factory):
+    llama_cfg = LlamaConfig.tiny(vocab_size=300)
+    enc_cfg = EncoderConfig.tiny(vocab_size=300)
+    cfg = AppConfig(
+        model=llama_cfg, encoder=enc_cfg,
+        flight=FlightConfig(
+            spool_dir=str(tmp_path_factory.mktemp("spool")), cooldown_s=0.0,
+        ),
+        tenants=TenantConfig(enabled=True, top_k=8),
+        system_message="Use the context.",
+    )
+    params = init_llama_params(jax.random.PRNGKey(0), llama_cfg, FP32)
+    engine = InferenceEngine(
+        llama_cfg, params,
+        sampling=SamplingConfig(do_sample=False, max_new_tokens=8),
+        engine_config=EngineConfig(
+            prompt_buckets=(128, 256), max_batch_size=2, max_seq_len=512,
+        ),
+        dtypes=FP32,
+    )
+    ceng = ContinuousEngine(
+        llama_cfg, params,
+        sampling=SamplingConfig(do_sample=False, max_new_tokens=8),
+        engine_config=EngineConfig(
+            prompt_buckets=(64, 256), max_batch_size=4, max_seq_len=320,
+        ),
+        dtypes=FP32,
+    )
+    sched = ContinuousScheduler(ceng, retry_backoff_s=0.0)
+    encoder = EncoderRunner(
+        enc_cfg, init_encoder_params(jax.random.PRNGKey(1), enc_cfg, FP32),
+        dtypes=FP32, length_buckets=(32, 64), max_batch=4,
+    )
+    store = VectorStore(dim=enc_cfg.hidden_size)
+    svc = RagService(
+        cfg, engine, ByteTokenizer(), encoder, ByteTokenizer(), store,
+        scheduler=sched,
+    )
+    svc.ready = True
+    texts = ["alpha beta gamma", "delta epsilon zeta"]
+    vecs = encoder.encode([ByteTokenizer().encode(t) for t in texts])
+    store.add(list(vecs), [
+        {"filename": "f", "chunk_id": i, "text": t}
+        for i, t in enumerate(texts)
+    ])
+    yield svc
+    svc.shutdown()
