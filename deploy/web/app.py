@@ -29,12 +29,25 @@ def new_traceparent() -> str:
     return f"00-{uuid.uuid4().hex}-{uuid.uuid4().hex[:16]}-01"
 
 
+def shed_reason(resp) -> str:
+    """The server's machine-readable shed cause from a 429/503 body:
+    ``"draining"`` (replica rolling — a retry lands on a healthy peer),
+    ``"breaker_open"`` (device resetting), or ``"queue_full"`` /
+    ``"concurrency"`` overload. Empty string when the body isn't the
+    server's JSON shape (e.g. a proxy's 503)."""
+    try:
+        return str(resp.json().get("reason", ""))
+    except ValueError:
+        return ""
+
+
 def post_generate(prompt: str, traceparent: str, status_slot, tenant: str = ""):
     """One /generate POST with ONE retry on connection errors and on
     overload sheds (429/503), honoring the server's ``Retry-After`` —
     the client half of the admission-control contract. Distinguishes
-    'overloaded, retrying' from a hard failure in the UI instead of
-    hanging the spinner."""
+    'overloaded, retrying' from 'replica rolling, retrying' (a graceful
+    drain's ``reason="draining"`` — routine, not a capacity problem)
+    from a hard failure in the UI instead of hanging the spinner."""
     headers = {"traceparent": traceparent}
     if tenant:
         headers["x-tenant-id"] = tenant
@@ -59,10 +72,19 @@ def post_generate(prompt: str, traceparent: str, status_slot, tenant: str = ""):
                 wait_s = float(resp.headers.get("Retry-After", "1"))
             except ValueError:
                 wait_s = 1.0
-            status_slot.warning(
-                f"Server overloaded ({resp.status_code}) — retrying in "
-                f"{wait_s:.0f}s…"
-            )
+            if shed_reason(resp) == "draining":
+                # planned shed: the pod is finishing its in-flight tail
+                # before a restart; the retry rides Retry-After onto a
+                # healthy replica (or the warm-restarted one)
+                status_slot.info(
+                    f"Replica rolling (graceful drain) — retrying in "
+                    f"{wait_s:.0f}s…"
+                )
+            else:
+                status_slot.warning(
+                    f"Server overloaded ({resp.status_code}) — retrying in "
+                    f"{wait_s:.0f}s…"
+                )
             time.sleep(min(wait_s, 10.0))
             continue
         return resp
@@ -93,11 +115,18 @@ if st.button("Generate") and prompt:
     status_slot.empty()
     if resp.status_code in (429, 503):
         body_text = resp.text
-        st.error(
-            "The server is overloaded and still shedding load after a "
-            f"retry (HTTP {resp.status_code}). Please try again shortly. "
-            f"Details: {body_text}"
-        )
+        if shed_reason(resp) == "draining":
+            st.info(
+                "The replica is restarting (graceful drain) and a retry "
+                "still landed on it. This is routine during a rolling "
+                f"deploy — try again in a moment. Details: {body_text}"
+            )
+        else:
+            st.error(
+                "The server is overloaded and still shedding load after a "
+                f"retry (HTTP {resp.status_code}). Please try again shortly. "
+                f"Details: {body_text}"
+            )
     elif resp.status_code == 200:
         body = resp.json()
         st.write(body.get("generated_text", ""))

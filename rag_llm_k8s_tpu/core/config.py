@@ -787,6 +787,16 @@ class ResilienceConfig:
     # (env TPU_RAG_INFLIGHT_RETRIES / TPU_RAG_RETRY_BACKOFF_MS)
     inflight_retries: int = 1
     retry_backoff_ms: float = 50.0
+    # graceful drain (resilience/lifecycle.py): how long in-flight work
+    # gets to finish after SIGTERM / POST /drain before the coordinator
+    # gives up, sheds the stragglers, and spools a drain_timeout incident.
+    # Must fit INSIDE the pod's terminationGracePeriodSeconds with margin
+    # for the persist step (env TPU_RAG_DRAIN_DEADLINE_S)
+    drain_deadline_s: float = 25.0
+    # the Retry-After hint on 503 reason="draining" sheds while the drain
+    # runs — sized to a replica roll, not a breaker cool-down
+    # (env TPU_RAG_DRAIN_RETRY_AFTER_S)
+    drain_retry_after_s: float = 2.0
 
 
 @dataclass(frozen=True)
@@ -906,6 +916,27 @@ class FlightConfig:
     # shape-only replay (lengths, not ids) is enough
     # (env TPU_RAG_FLIGHT_ARRIVAL_IDS)
     arrival_ids: bool = True
+    # durable flight WAL (obs/flight.py::FlightWAL): tee every journal
+    # event onto disk as fsynced JSON lines so in-flight work survives
+    # SIGKILL and a warm restart (server/main.py) can resume it. OFF by
+    # default — the fsync-per-window tax only buys something where the
+    # directory survives the pod (the deployment pins it on the PVC)
+    # (env TPU_RAG_FLIGHT_WAL / TPU_RAG_FLIGHT_WAL_DIR)
+    wal: bool = False
+    wal_dir: str = "/tmp/tpu_rag_wal"
+    # WAL bounds: events per segment file before rotation, and total
+    # segment files kept across incarnations (oldest pruned) — the WAL is
+    # a bounded flight journal, not an unbounded database
+    # (env TPU_RAG_FLIGHT_WAL_SEGMENT_EVENTS / TPU_RAG_FLIGHT_WAL_SEGMENTS)
+    wal_segment_events: int = 256
+    wal_segments: int = 64
+    # warm restart: scan the previous incarnation's WAL epoch on boot and
+    # resubmit its in-flight requests through the scheduler's fold path
+    # (env TPU_RAG_FLIGHT_WAL_RESTORE); cap on warmth-manifest entries
+    # re-staged into the prefix cache first — 0 skips rehydration
+    # (env TPU_RAG_FLIGHT_WAL_RESTORE_CHUNKS)
+    wal_restore: bool = True
+    wal_restore_chunks: int = 8
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "FlightConfig":
@@ -946,6 +977,31 @@ class FlightConfig:
                     f"TPU_RAG_FLIGHT_COOLDOWN_S={v}: expected >= 0"
                 )
             out = dataclasses.replace(out, cooldown_s=v)
+        _flag("TPU_RAG_FLIGHT_WAL", "wal")
+        _flag("TPU_RAG_FLIGHT_WAL_RESTORE", "wal_restore")
+        if "TPU_RAG_FLIGHT_WAL_DIR" in env:
+            out = dataclasses.replace(out, wal_dir=env["TPU_RAG_FLIGHT_WAL_DIR"])
+        if "TPU_RAG_FLIGHT_WAL_SEGMENT_EVENTS" in env:
+            n = int(env["TPU_RAG_FLIGHT_WAL_SEGMENT_EVENTS"])
+            if n < 1:
+                raise ValueError(
+                    f"TPU_RAG_FLIGHT_WAL_SEGMENT_EVENTS={n}: expected >= 1"
+                )
+            out = dataclasses.replace(out, wal_segment_events=n)
+        if "TPU_RAG_FLIGHT_WAL_SEGMENTS" in env:
+            n = int(env["TPU_RAG_FLIGHT_WAL_SEGMENTS"])
+            if n < 2:
+                raise ValueError(
+                    f"TPU_RAG_FLIGHT_WAL_SEGMENTS={n}: expected >= 2"
+                )
+            out = dataclasses.replace(out, wal_segments=n)
+        if "TPU_RAG_FLIGHT_WAL_RESTORE_CHUNKS" in env:
+            n = int(env["TPU_RAG_FLIGHT_WAL_RESTORE_CHUNKS"])
+            if n < 0:
+                raise ValueError(
+                    f"TPU_RAG_FLIGHT_WAL_RESTORE_CHUNKS={n}: expected >= 0"
+                )
+            out = dataclasses.replace(out, wal_restore_chunks=n)
         return out
 
 
@@ -1409,6 +1465,8 @@ class AppConfig:
         _res_float("TPU_RAG_BREAKER_WINDOW_S", "breaker_window_s", 1.0)
         _res_int("TPU_RAG_INFLIGHT_RETRIES", "inflight_retries", 0)
         _res_float("TPU_RAG_RETRY_BACKOFF_MS", "retry_backoff_ms", 0.0)
+        _res_float("TPU_RAG_DRAIN_DEADLINE_S", "drain_deadline_s", 0.1)
+        _res_float("TPU_RAG_DRAIN_RETRY_AFTER_S", "drain_retry_after_s", 0.0)
         lookahead = cfg.lookahead
 
         def _la_flag(var: str, field_name: str):

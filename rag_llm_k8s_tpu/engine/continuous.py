@@ -140,6 +140,10 @@ class _Slot:
     # host AND device), but not free either. The admission record itself
     # lives in ``_chunk_admissions``; the flag keeps ``free_slots`` honest.
     prefilling: bool = False
+    # flight-WAL watermark: how many of ``tokens`` have been journaled as
+    # token_emit events (``_journal_emitted``); only the delta past it is
+    # re-journaled each window, so the WAL carries each token once
+    wal_mark: int = 0
 
 
 class ContinuousEngine:
@@ -2248,6 +2252,24 @@ class ContinuousEngine:
         if summary is not None:
             flight.emit("goodput_window", **summary)
 
+    def _journal_emitted(self) -> None:
+        """Flight-WAL watermark pass (every sync-window drain): journal
+        each live row's emitted-token delta as one ``token_emit`` event,
+        so concatenating a request's token_emit events rebuilds its full
+        emitted stream — the state a warm restart folds back in. Gated on
+        an attached WAL: without one this is a no-op (the ring needs no
+        per-window token copies; greedy resume recomputes). Tokens
+        appended after the last window before a SIGKILL are simply
+        recomputed on resume — deterministic decode makes the tail safe
+        to lose."""
+        if not flight.wal_enabled():
+            return
+        for slot in self.slots:
+            if slot.active and len(slot.tokens) > slot.wal_mark:
+                flight.emit("token_emit", slot.request_id,
+                            toks=slot.tokens[slot.wal_mark:])
+                slot.wal_mark = len(slot.tokens)
+
     def blocks_needed(self, prompt_len: int) -> int:
         """Admission-time block cost of a prompt (0 in dense mode)."""
         if not self.paged:
@@ -3069,6 +3091,7 @@ class ContinuousEngine:
             decode_kept=kept, chunk_rows=chunk_led,
             rework=self._take_rework(chunk_led), ctx_tokens=ctx,
         ))
+        self._journal_emitted()
         flight.emit(
             "sync_window_close", steps=1, done=len(done),
             duration_ms=round((time.perf_counter() - t0) * 1e3, 3),
@@ -3192,6 +3215,7 @@ class ContinuousEngine:
             time.perf_counter() - t_w, batch=self.B, steps=k,
             kept=kept, ctx_tokens=ctx,
         ))
+        self._journal_emitted()
         flight.emit(
             "sync_window_close", steps=k, done=len(done),
             duration_ms=round((time.perf_counter() - t0) * 1e3, 3),
@@ -3376,6 +3400,7 @@ class ContinuousEngine:
             rows=led_rows,
             ctx_tokens=sum(s.kv_ub for s in self.slots if s.active),
         ))
+        self._journal_emitted()
         flight.emit(
             "sync_window_close", steps=1, done=len(done),
             duration_ms=round((time.perf_counter() - t0) * 1e3, 3),
@@ -3468,6 +3493,7 @@ class ContinuousScheduler:
         deadline: Optional[Deadline] = None,
         info: Optional[Dict] = None,  # out-param: per-request engine facts
         tenant: Optional[str] = None,  # edge-interned tenant (bounded set)
+        resume_emitted: Optional[Sequence[int]] = None,  # warm restart: prior tokens
     ) -> List[int]:
         if self._stop.is_set():
             raise RuntimeError("scheduler is shut down")
@@ -3503,6 +3529,23 @@ class ContinuousScheduler:
         if flight.arrival_ids():
             arr["ids"] = list(item.prompt)
         flight.emit("arrival", rid, **arr)
+        if resume_emitted:
+            # warm restart (server/main.py): tokens a dead incarnation's
+            # WAL proved emitted fold in through the SAME path a preempt
+            # resume uses — the prompt grows, the budget shrinks, and the
+            # delivered stream stays byte-identical to an uninterrupted
+            # run. The arrival above recorded the ORIGINAL prompt; the
+            # token_emit re-journals the folded tokens into THIS
+            # incarnation's WAL so a second crash still reconstructs the
+            # full stream from one epoch.
+            self._fold_emitted(item, list(resume_emitted))
+            if item.emitted:
+                item.resumed = True
+                flight.emit("token_emit", rid, toks=list(item.emitted))
+            flight.emit(
+                "resubmit", rid, outcome="restored",
+                n_emitted=len(item.emitted),
+            )
         with self._lifecycle_lock:  # stop-check + enqueue must be atomic
             if self._stop.is_set():
                 raise RuntimeError("scheduler is shut down")

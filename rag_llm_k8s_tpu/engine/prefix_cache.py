@@ -159,6 +159,11 @@ def _planes_nbytes(planes: Tuple) -> int:
     return int(sum(int(p.nbytes) for p in planes))
 
 
+#: warmth-manifest side table bound: segment keys whose token ids are kept
+#: for cross-restart rehydration (LRU; ids, not KV — a few KB per segment)
+_SEG_IDS_CAP = 256
+
+
 class PrefixCache:
     """HBM-budgeted LRU of segment KV blocks + assembled prefix buffers.
 
@@ -238,6 +243,13 @@ class PrefixCache:
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self._assembled: "OrderedDict[tuple, Tuple[Tuple, int]]" = OrderedDict()
+        # warmth manifest source (ISSUE 19): the token ids behind each
+        # resolved segment key, LRU-bounded. KV planes cannot cross a
+        # process boundary, but (key, ids) can — a warm restart re-prefills
+        # the hottest segments from this table's persisted form so the
+        # cache does not come back empty (_SEG_IDS_CAP bounds the memory:
+        # ids are small next to the KV they describe, but not free)
+        self._seg_ids: "OrderedDict[str, List[int]]" = OrderedDict()
         # consumptions per assembled buffer since creation (keys ⊆
         # _assembled) — same stale-release discipline as _Entry.uses
         self._assembled_uses: Dict[tuple, int] = {}
@@ -278,6 +290,38 @@ class PrefixCache:
             for k, e in self._entries.items():
                 if k[0] == seg_key:
                     e.pinned = True
+
+    # -- warmth manifest (ISSUE 19) --------------------------------------
+    def warmth_manifest(self, top_n: int = 8) -> List[Dict]:
+        """The hottest resolved segments as JSON-ready ``{key, ids,
+        tokens, score, spilled}`` records, hotness-ranked — what a
+        graceful drain persists (durably, next to the WAL) so the NEXT
+        incarnation can re-prefill the working set before traffic
+        arrives. Only segments whose ids are still in the bounded side
+        table qualify; ``spilled`` marks segments whose KV sat in the
+        host spill store (HA-RAG's argument: those are exactly the
+        chunks worth staging first)."""
+        tracker = (
+            self.hotness if self.hotness is not None
+            else self._chunk_hotness
+        )
+        with self._lock:
+            items = [(k, list(v)) for k, v in self._seg_ids.items()]
+            spilled_keys = set()
+            if self.spill is not None:
+                for rec in self.spill.manifest():
+                    ek = rec["key"]
+                    spilled_keys.add(ek[0] if isinstance(ek, tuple) else ek)
+        out = []
+        for key, ids in items:
+            score = float(tracker.score(key)) if tracker is not None else 0.0
+            out.append({
+                "key": key, "ids": ids, "tokens": len(ids),
+                "score": round(score, 6),
+                "spilled": key in spilled_keys,
+            })
+        out.sort(key=lambda r: (-r["score"], str(r["key"])))
+        return out[:max(0, int(top_n))]
 
     # -- stats ----------------------------------------------------------
     def counters(self) -> Dict[str, int]:
@@ -357,6 +401,11 @@ class PrefixCache:
         chain_full = tuple(k for k, _ in segments)
         akey = (chain_full, total)
         with self._lock:
+            for key, ids in segments:
+                self._seg_ids[key] = list(ids)
+                self._seg_ids.move_to_end(key)
+            while len(self._seg_ids) > _SEG_IDS_CAP:
+                self._seg_ids.popitem(last=False)
             memo = self._assembled.get(akey)
             if memo is not None:
                 self._assembled.move_to_end(akey)

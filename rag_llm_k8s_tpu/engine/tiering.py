@@ -200,6 +200,20 @@ class HostSpillStore:
         with self._lock:
             return key in self._data
 
+    def manifest(self) -> list:
+        """The store's inventory, oldest first: ``{key, nbytes, meta}``
+        per spilled entry. Host buffers die with the process, but the
+        manifest's identity (which keys were cold-but-kept, how big)
+        feeds the prefix cache's warmth manifest (ISSUE 19): the chunks
+        a crashed replica had spilled are exactly the ones a warm
+        restart re-stages first."""
+        with self._lock:
+            return [
+                {"key": key, "nbytes": self._data[key][2],
+                 "meta": dict(self._data[key][1])}
+                for key in self._order
+            ]
+
 
 @jax.jit
 def _quantize_pair(k, v):

@@ -26,6 +26,14 @@ in aggregate. This module is the journal those decisions write to:
   trace ring into ONE self-contained JSON file on a bounded on-disk spool
   (``GET /debug/incidents``), so reconstructing an incident needs no live
   pod. ``scripts/flightview.py`` renders a bundle offline.
+- :class:`FlightWAL` — the DURABLE tee: every emitted event also lands on
+  disk as one fsynced JSON line in a bounded, segment-rotated,
+  epoch-per-incarnation journal. The ring explains a live process; the
+  WAL explains a dead one — a warm restart (server/main.py) scans it,
+  finds requests with an ``arrival`` but no terminal event, and resumes
+  them through the scheduler's fold path. All spool/WAL file writes share
+  :func:`durable_write`'s tmp-fsync-rename discipline (ragcheck
+  DURABLE-WRITE pins this).
 
 The journal is a STABLE CONTRACT: every event and bundle carries
 :data:`SCHEMA_VERSION`, bumped whenever an event's meaning or a bundle
@@ -50,15 +58,19 @@ __all__ = [
     "EVENTS",
     "SCHEMA_VERSION",
     "FlightRecorder",
+    "FlightWAL",
     "IncidentSpooler",
     "arrival_ids",
     "config_fingerprint",
     "configure",
+    "durable_write",
     "emit",
     "export_journal",
     "load_journal",
     "recorder",
+    "scan_wal",
     "stream_hash",
+    "wal_enabled",
 ]
 
 logger = logging.getLogger(__name__)
@@ -90,11 +102,17 @@ EVENTS: Dict[str, str] = {
                   "(blocks added, total mapped)",
     "reset": "engine device state rebuilt after a failed step/insert "
              "(every in-flight slot wiped)",
-    "resubmit": "in-flight request re-queued after a reset or preemption "
-                "(outcome: resubmitted | preempt_resume | gave_up; "
-                "n_emitted tokens carried over)",
+    "resubmit": "in-flight request re-queued after a reset, preemption, or "
+                "warm restart (outcome: resubmitted | preempt_resume | "
+                "gave_up | restored; n_emitted tokens carried over)",
     "complete": "request delivered (n_tokens, stream_fnv — FNV-1a over "
                 "the emitted token stream, the byte-consistency anchor)",
+    "token_emit": "a row's emitted-token delta journaled at a sync-window "
+                  "drain while the flight WAL is on (toks — the tokens "
+                  "appended since the row's last watermark); concatenating "
+                  "a request's token_emit events in seq order rebuilds its "
+                  "full emitted stream, the state a warm restart resumes "
+                  "from",
     "spec_draft": "a speculative sync window drafted continuations by "
                   "prompt-lookup over each row's history (rows drafting, "
                   "active rows, drafted tokens total)",
@@ -168,6 +186,14 @@ EVENTS: Dict[str, str] = {
     "deadline": "a request's end-to-end deadline expired (stage)",
     "breaker_open": "the engine-reset circuit breaker flipped open "
                     "(resets in window) — readiness goes 503",
+    "drain": "the lifecycle coordinator changed drain phase (phase: begin "
+             "| timeout | complete; reason on begin, in_flight counts) — "
+             "the graceful-shutdown state machine's journal trail",
+    "restore": "a warm restart acted on a prior incarnation's WAL (phase: "
+               "resume — one in-flight request resubmitted with orig_rid/"
+               "n_emitted; rehydrate — warmth-manifest chunks re-staged; "
+               "skip — a request the restart could not resume, with "
+               "reason)",
 }
 
 
@@ -204,6 +230,9 @@ class FlightRecorder:
         # exact-replay trace record); off, they keep prompt_len only —
         # the journal stays sized in events, not prompt tokens
         self.arrival_ids = bool(arrival_ids)
+        # durable tee: a FlightWAL every emitted event is also appended to
+        # (crash-consistent; the warm-restart substrate). None = ring only.
+        self.wal: Optional["FlightWAL"] = None
         self._lock = threading.Lock()
         self._buf: List[Optional[tuple]] = [None] * self.capacity
         self._next = 0  # total events ever emitted (seq of the next event)
@@ -227,6 +256,14 @@ class FlightRecorder:
             # the seq is stamped under the lock so journal order and slot
             # claim agree even across producers
             self._buf[seq % self.capacity] = (seq,) + ev[1:]
+        wal = self.wal
+        if wal is not None:
+            d = {"seq": seq, "t": round(ev[1], 6), "type": etype}
+            if request_id is not None:
+                d["rid"] = request_id
+            if attrs:
+                d.update(attrs)
+            wal.append(d)
 
     # -- read ------------------------------------------------------------
     @property
@@ -297,24 +334,33 @@ def recorder() -> FlightRecorder:
     return _RECORDER
 
 
+_UNSET = object()
+
+
 def configure(enabled: Optional[bool] = None,
               capacity: Optional[int] = None,
-              arrival_ids: Optional[bool] = None) -> FlightRecorder:
+              arrival_ids: Optional[bool] = None,
+              wal=_UNSET) -> FlightRecorder:
     """Apply ``FlightConfig`` to the process recorder (the service calls
     this at construction; bench legs toggle ``enabled`` directly). A
     capacity change rebuilds the ring (journal starts fresh); an
-    enabled-only change keeps it."""
+    enabled-only change keeps it. ``wal`` attaches (a :class:`FlightWAL`)
+    or detaches (None) the durable tee; omitted, the current tee stays."""
     global _RECORDER
     if capacity is not None and int(capacity) != _RECORDER.capacity:
+        old = _RECORDER
         _RECORDER = FlightRecorder(
             int(capacity),
-            _RECORDER.enabled if enabled is None else bool(enabled),
-            _RECORDER.arrival_ids if arrival_ids is None else bool(arrival_ids),
+            old.enabled if enabled is None else bool(enabled),
+            old.arrival_ids if arrival_ids is None else bool(arrival_ids),
         )
+        _RECORDER.wal = old.wal
     elif enabled is not None:
         _RECORDER.enabled = bool(enabled)
     if arrival_ids is not None:
         _RECORDER.arrival_ids = bool(arrival_ids)
+    if wal is not _UNSET:
+        _RECORDER.wal = wal
     return _RECORDER
 
 
@@ -335,6 +381,15 @@ def arrival_ids() -> bool:
     return rec.enabled and rec.arrival_ids
 
 
+def wal_enabled() -> bool:
+    """Whether emitted events reach a durable WAL — the gate the engine's
+    ``token_emit`` journaling checks per sync window, so the extra
+    per-window emit (and its fsync) costs nothing when no WAL is
+    attached."""
+    rec = _RECORDER
+    return rec.enabled and rec.wal is not None
+
+
 # ---------------------------------------------------------------------------
 # journal export / ingest (the replay harness's file format)
 # ---------------------------------------------------------------------------
@@ -352,8 +407,7 @@ def export_journal(path: str, events: Optional[List[Dict]] = None,
     if meta:
         for k, v in meta.items():
             bundle.setdefault(k, v)
-    with open(path, "w") as f:
-        json.dump(bundle, f, separators=(",", ":"))
+    durable_write(path, bundle)
     return bundle
 
 
@@ -377,6 +431,192 @@ def load_journal(path: str) -> List[Dict]:
     if not isinstance(journal, list):
         raise ValueError(f"{path}: no 'journal' event list in bundle")
     return journal
+
+
+# ---------------------------------------------------------------------------
+# durable writes + the flight WAL
+# ---------------------------------------------------------------------------
+
+
+def durable_write(path: str, obj: Dict) -> None:
+    """THE crash-consistent JSON write: tmp file → flush → fsync →
+    ``os.replace`` → directory fsync. A reader never sees a torn or empty
+    file — it sees the old content or the new content, even across
+    SIGKILL/power loss. Every spool/WAL-adjacent write in this module and
+    ``resilience/lifecycle.py`` goes through here (ragcheck DURABLE-WRITE
+    mechanizes that), so the discipline cannot quietly regress one call
+    site at a time."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, separators=(",", ":"))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    # fsync the directory so the rename itself survives a crash — without
+    # it the data is durable but the NAME may not be
+    dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+class FlightWAL:
+    """Bounded, segment-rotated write-ahead journal of flight events.
+
+    The ring answers "what just happened" for a LIVE process; the WAL
+    answers it for a DEAD one. Attached to the recorder (``configure(wal=
+    …)``) it tees every emitted event onto disk as one JSON line, fsynced
+    per append, under ``dir/wal_<epoch>_<seg>.jsonl``:
+
+    - **epoch** — one per process incarnation, ``max(existing) + 1`` at
+      construction. A restart never appends into a dead incarnation's
+      segments, so "what was in flight when we died" stays frozen exactly
+      as the crash left it.
+    - **segments** — a new file every ``segment_events`` appends; the
+      oldest files past ``max_segments`` (across ALL epochs) are pruned.
+      The WAL is a bounded flight journal, not an unbounded database.
+    - **torn tails** — an append killed mid-write leaves a partial final
+      line in one segment; :func:`scan_wal` skips unparseable lines, so a
+      SIGKILL costs at most the one event being written.
+
+    Appends take one lock and one fsync — this is the durability tax the
+    warm-restart contract pays, measured by the bench ``restart_warmth``
+    leg's WAL-on throughput column. A failed append logs and drops the
+    event rather than taking the serving path down.
+    """
+
+    def __init__(self, dir: str, segment_events: int = 256,
+                 max_segments: int = 64):
+        if segment_events < 1:
+            raise ValueError(
+                f"segment_events={segment_events}: expected >= 1")
+        if max_segments < 2:
+            raise ValueError(f"max_segments={max_segments}: expected >= 2")
+        self.dir = dir
+        self.segment_events = int(segment_events)
+        self.max_segments = int(max_segments)
+        os.makedirs(dir, exist_ok=True)
+        existing = _wal_segments(dir)
+        self.epoch = (max(e for e, _, _ in existing) + 1) if existing else 1
+        self._lock = threading.Lock()
+        self._seg = 0
+        self._file = None
+        self._seg_events = 0
+        self.appends = 0
+        self.dropped = 0
+
+    # -- write -----------------------------------------------------------
+    def append(self, event: Dict) -> None:
+        """Durably append one event dict (one JSON line + fsync). Never
+        raises — WAL trouble (disk full, dir vanished) must not break the
+        emit path; dropped appends are counted."""
+        try:
+            with self._lock:
+                if self._file is None or self._seg_events >= self.segment_events:
+                    self._rotate_locked()
+                self._file.write(
+                    json.dumps(event, separators=(",", ":")) + "\n"
+                )
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                self._seg_events += 1
+                self.appends += 1
+        except Exception:  # noqa: BLE001 — durability is best-effort here
+            self.dropped += 1
+            logger.warning("flight WAL append failed (dir=%s)", self.dir,
+                           exc_info=True)
+
+    def _rotate_locked(self) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+        self._seg += 1
+        path = os.path.join(
+            self.dir, f"wal_{self.epoch:08d}_{self._seg:06d}.jsonl"
+        )
+        # append mode: a crashed-then-restarted SAME epoch cannot happen
+        # (epochs are unique), but "a" never truncates evidence either way
+        self._file = open(path, "a")
+        self._seg_events = 0
+        self._prune_locked()
+
+    def _prune_locked(self) -> None:
+        segs = _wal_segments(self.dir)
+        while len(segs) > self.max_segments:
+            _e, _s, name = segs.pop(0)  # oldest (names sort by epoch/seg)
+            try:
+                os.remove(os.path.join(self.dir, name))
+            except OSError:
+                pass
+
+    def sync(self) -> None:
+        """Flush + fsync the open segment (drain's persist step calls this
+        before the process exits)."""
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.flush()
+                    os.fsync(self._file.fileno())
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+
+def _wal_segments(dir: str) -> List[tuple]:
+    """Sorted ``(epoch, seg, filename)`` for every WAL segment in ``dir``
+    (malformed names are ignored, not fatal — the dir may be shared)."""
+    out = []
+    try:
+        names = os.listdir(dir)
+    except OSError:
+        return []
+    for n in names:
+        if not (n.startswith("wal_") and n.endswith(".jsonl")):
+            continue
+        parts = n[len("wal_"):-len(".jsonl")].split("_")
+        if len(parts) != 2 or not (parts[0].isdigit() and parts[1].isdigit()):
+            continue
+        out.append((int(parts[0]), int(parts[1]), n))
+    out.sort()
+    return out
+
+
+def scan_wal(dir: str) -> Dict[int, List[Dict]]:
+    """Read a WAL directory back to ``{epoch: [events]}``, each epoch's
+    events in seq order. Unparseable lines (the torn tail a SIGKILL leaves)
+    and unreadable segments are skipped — a scan is best-effort archaeology
+    over a dead process, never a gate the restart can fail on."""
+    epochs: Dict[int, List[Dict]] = {}
+    for epoch, _seg, name in _wal_segments(dir):
+        try:
+            with open(os.path.join(dir, name)) as f:
+                raw = f.read()
+        except OSError:
+            continue
+        for line in raw.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue  # torn tail (or garbage) — skip, keep scanning
+            if isinstance(ev, dict):
+                epochs.setdefault(epoch, []).append(ev)
+    for evs in epochs.values():
+        evs.sort(key=lambda e: e.get("seq", 0))
+    return epochs
 
 
 # ---------------------------------------------------------------------------
@@ -413,7 +653,7 @@ def config_fingerprint(config) -> Dict:
 #: incident triggers the spooler accepts (closed, like the event catalog)
 TRIGGERS = (
     "breaker_open", "reset_storm", "pool_exhausted_shed", "deadline_exceeded",
-    "quality_divergence",
+    "quality_divergence", "drain_timeout",
 )
 
 
@@ -421,8 +661,9 @@ class IncidentSpooler:
     """Bounded on-disk spool of self-contained incident bundles.
 
     ``trigger(name, context_fn)`` writes ``context_fn()`` + trigger
-    metadata as one JSON file (write-tmp-then-rename — a bundle is never
-    torn) and prunes the oldest files past ``max_bundles``. Per-trigger
+    metadata as one JSON file (through :func:`durable_write`'s
+    tmp-fsync-rename — a bundle is never torn) and prunes the oldest
+    files past ``max_bundles``. Per-trigger
     cooldown keeps a storm from writing a bundle per reset: the FIRST
     occurrence captures the journal that explains the rest.
 
@@ -471,10 +712,7 @@ class IncidentSpooler:
             bundle["id"] = bid
             os.makedirs(self.spool_dir, exist_ok=True)
             path = os.path.join(self.spool_dir, f"incident_{bid}.json")
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(bundle, f, separators=(",", ":"))
-            os.replace(tmp, path)
+            durable_write(path, bundle)
             self._prune()
             return bid
         except Exception:  # noqa: BLE001 — capture must not fail serving

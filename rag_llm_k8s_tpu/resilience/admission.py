@@ -65,6 +65,12 @@ class AdmissionController:
         self._cv = threading.Condition()
         self.active = 0
         self.waiting = 0
+        # lifecycle drain (resilience/lifecycle.py): once set, EVERY new
+        # or queued request is shed with 503 reason="draining" while the
+        # already-admitted ones run to completion — the gate is how a
+        # rolling replica stops taking work without dropping work
+        self._draining = False
+        self._drain_retry_after_s = retry_after_s
         # set by the service (obs wiring): labeled-counter families for
         # rag_admission_rejected_total / rag_deadline_exceeded_total —
         # None keeps the gate standalone
@@ -119,6 +125,9 @@ class AdmissionController:
 
     def _acquire(self, deadline: Optional[Deadline],
                  tenant: Optional[str] = None) -> None:
+        if self._draining:
+            self._reject("draining", 503, self._drain_retry_after_s,
+                         tenant=tenant)
         breaker = self.breaker
         if breaker is not None and breaker.open:
             # draining: shed EVERYTHING, even below the concurrency cap —
@@ -147,6 +156,12 @@ class AdmissionController:
             self.waiting += 1
             try:
                 while self.active >= self.max_concurrency:
+                    if self._draining:
+                        # a drain beginning while we queued: shed NOW —
+                        # queued work is exactly what a drain refuses to
+                        # start (_reject's raise unwinds through finally)
+                        self._reject("draining", 503,
+                                     self._drain_retry_after_s, tenant=tenant)
                     if deadline is not None:
                         if deadline.expired():
                             fam = self.deadline_counter
@@ -186,3 +201,18 @@ class AdmissionController:
     def queue_depth(self) -> int:
         """Requests currently waiting at the gate (for the depth gauge)."""
         return self.waiting
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, retry_after_s: Optional[float] = None) -> None:
+        """Flip the gate to draining: every queued waiter wakes and sheds
+        503 reason="draining"; every later arrival sheds at the door.
+        Idempotent; there is deliberately NO undrain — a draining process
+        exits (tests rebuild the gate instead)."""
+        with self._cv:
+            if retry_after_s is not None:
+                self._drain_retry_after_s = float(retry_after_s)
+            self._draining = True
+            self._cv.notify_all()

@@ -25,6 +25,7 @@ is single-writer; persistence is atomic.
 from __future__ import annotations
 
 import io
+import json
 import logging
 import math
 import os
@@ -56,6 +57,7 @@ from rag_llm_k8s_tpu.resilience import faults
 from rag_llm_k8s_tpu.resilience.admission import AdmissionController, AdmissionRejected
 from rag_llm_k8s_tpu.resilience.breaker import CircuitBreaker
 from rag_llm_k8s_tpu.resilience.deadline import Deadline, DeadlineExceeded
+from rag_llm_k8s_tpu.resilience.lifecycle import LifecycleCoordinator
 from rag_llm_k8s_tpu.utils.tokens import truncate_keep_eos
 
 logger = logging.getLogger(__name__)
@@ -193,10 +195,27 @@ class RagService:
         # write to it long before any service exists), so the service only
         # APPLIES its config and owns the incident spool
         fl = getattr(config, "flight", None)
+        # durable flight WAL (ISSUE 19): when armed, every journal event
+        # also lands fsynced on disk — the crash-consistent record a warm
+        # restart resumes in-flight work from. Construction failure
+        # (read-only dir, bad mount) degrades to ring-only, never fatal.
+        self.flight_wal = None
         if fl is not None:
+            if getattr(fl, "wal", False):
+                try:
+                    self.flight_wal = obs_flight.FlightWAL(
+                        fl.wal_dir,
+                        segment_events=fl.wal_segment_events,
+                        max_segments=fl.wal_segments,
+                    )
+                except OSError:
+                    logger.exception(
+                        "flight WAL unavailable at %s; running ring-only",
+                        fl.wal_dir,
+                    )
             obs_flight.configure(
                 enabled=fl.enabled, capacity=fl.capacity,
-                arrival_ids=fl.arrival_ids,
+                arrival_ids=fl.arrival_ids, wal=self.flight_wal,
             )
         self.incidents = (
             obs_flight.IncidentSpooler(
@@ -233,6 +252,19 @@ class RagService:
         self.breaker.on_open = lambda: self.record_incident("breaker_open")
         self.breaker.on_reset = self._maybe_reset_storm
         self.admission.incident_hook = self.record_incident
+        # crash-safe lifecycle (ISSUE 19): SIGTERM / POST /drain flips the
+        # gate to shed queued+new work with 503 "draining", waits out the
+        # in-flight under res.drain_deadline_s, persists (WAL sync + the
+        # warmth manifest), then exits. exit_fn stays None here — only the
+        # real entrypoint (server/main.py) arms an actual process exit;
+        # tests observe the drained state instead.
+        self.lifecycle = LifecycleCoordinator(
+            admission=self.admission,
+            deadline_s=res.drain_deadline_s,
+            retry_after_s=res.drain_retry_after_s,
+            persist_fn=self._persist_for_restart,
+            incident_hook=self.record_incident,
+        )
         self.ready = False
         # per-stage in-flight counters, fed to the coalescers as
         # ``pending_hint``: each batching stage stops waiting out its window
@@ -1087,6 +1119,151 @@ class RagService:
         if bid is not None:
             self._m_incidents.labels(trigger=trigger).inc()
         return bid
+
+    # -- crash-safe lifecycle (ISSUE 19) ---------------------------------
+    def _persist_for_restart(self) -> None:
+        """The drain coordinator's persist step: fsync the WAL tail (the
+        last windows' token_emit deltas become durable) and write the
+        warmth manifest next to it — everything the NEXT incarnation needs
+        to come back warm. Best-effort: a failed persist degrades the
+        restart to cold, never blocks the exit."""
+        wal = self.flight_wal
+        if wal is not None:
+            wal.sync()
+        try:
+            self._write_warmth_manifest()
+        except Exception:  # noqa: BLE001 — persist must not stall the exit
+            logger.exception("warmth manifest write failed")
+
+    def _write_warmth_manifest(self) -> Optional[str]:
+        """Durably write the prefix cache's hottest (key, ids) records
+        into the WAL dir (``durable_write`` — a reader sees old or new,
+        never torn). Returns the path, or None when there is nothing to
+        write (no WAL, rehydration disabled, no cache)."""
+        fl = getattr(self.config, "flight", None)
+        wal = self.flight_wal
+        if wal is None or fl is None or fl.wal_restore_chunks <= 0:
+            return None
+        cache = getattr(self.engine, "prefix_cache", None)
+        if cache is None or not hasattr(cache, "warmth_manifest"):
+            return None
+        entries = cache.warmth_manifest(top_n=fl.wal_restore_chunks)
+        path = os.path.join(fl.wal_dir, "warmth_manifest.json")
+        obs_flight.durable_write(path, {
+            "schema_version": obs_flight.SCHEMA_VERSION,
+            "ts": time.time(),
+            "entries": entries,
+        })
+        return path
+
+    def _rehydrate_warmth(self, fl) -> int:
+        """Re-prefill the warmth manifest's segments through the prefix
+        cache's ordinary resolve path (``prefix_for`` — the miss path IS
+        the populate path), hottest first, capped at
+        ``wal_restore_chunks``. Returns segments staged."""
+        if fl.wal_restore_chunks <= 0:
+            return 0
+        cache = getattr(self.engine, "prefix_cache", None)
+        if cache is None or not hasattr(cache, "prefix_for"):
+            return 0
+        path = os.path.join(fl.wal_dir, "warmth_manifest.json")
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return 0  # no manifest (first boot / SIGKILL before any drain)
+        staged = 0
+        for ent in doc.get("entries", ())[:fl.wal_restore_chunks]:
+            key, ids = ent.get("key"), ent.get("ids")
+            if not key or not ids:
+                continue
+            try:
+                got = cache.prefix_for([(str(key), [int(x) for x in ids])])
+            except Exception:  # noqa: BLE001 — warmth is opportunistic
+                logger.exception("warmth rehydrate failed (key=%s)", key)
+                break
+            if got is not None:
+                staged += 1
+                obs_flight.emit("restore", phase="rehydrate", key=str(key),
+                                tokens=len(ids))
+        return staged
+
+    def restore_from_wal(self, wait: bool = False) -> Dict:
+        """Warm restart: pre-stage the warmth manifest, then scan the
+        previous incarnation's WAL epoch for requests that died in flight
+        and resubmit each through the scheduler's fold path
+        (``resume_emitted`` — the WAL-proven emitted tokens fold in, the
+        greedy continuation stays byte-identical to an uninterrupted
+        run). Their original callers are gone; completing them makes the
+        journal whole (``complete.stream_fnv``) and the prefill work
+        heats the cache for their retries. Returns a summary; with
+        ``wait=True`` blocks for the resumed completions and includes
+        their delivered streams (keyed by ORIGINAL rid — the chaos test's
+        oracle hook)."""
+        fl = getattr(self.config, "flight", None)
+        wal = self.flight_wal
+        summary: Dict = {"resumed": 0, "skipped": 0, "rehydrated": 0,
+                         "results": {}}
+        if wal is None or fl is None or not fl.wal_restore:
+            return summary
+        summary["rehydrated"] = self._rehydrate_warmth(fl)
+        epochs = obs_flight.scan_wal(fl.wal_dir)
+        dead = [e for e in sorted(epochs) if e < wal.epoch]
+        if not dead:
+            return summary
+        # only the LATEST dead epoch: anything older and unfinished was
+        # either restored into it (and re-journaled there as a fresh
+        # arrival + token_emit) or lost to segment pruning
+        from rag_llm_k8s_tpu.sim import replay as sim_replay
+
+        orig_epoch = dead[-1]
+        records = sim_replay.extract_inflight(epochs[orig_epoch])["inflight"]
+        sched = self.scheduler
+        if records and not hasattr(sched, "_fold_emitted"):
+            for rec in records:
+                summary["skipped"] += 1
+                obs_flight.emit("restore", phase="skip",
+                                orig_rid=rec["rid"], reason="no_scheduler")
+            return summary
+        threads = []
+        lock = threading.Lock()
+        for rec in records:
+            if rec["synthetic_prompt"]:
+                # the dead recorder kept lengths only (arrival_ids off):
+                # a resume would continue a filler prompt, not the
+                # request — journal the gap instead of faking the stream
+                summary["skipped"] += 1
+                obs_flight.emit("restore", phase="skip",
+                                orig_rid=rec["rid"],
+                                reason="synthetic_prompt")
+                continue
+            summary["resumed"] += 1
+            obs_flight.emit("restore", phase="resume",
+                            orig_rid=rec["rid"], orig_epoch=orig_epoch,
+                            n_emitted=len(rec["emitted"]))
+
+            def _resume(rec=rec):
+                try:
+                    toks = sched.submit(
+                        rec["prompt"], max_new_tokens=rec["max_new"],
+                        seed=rec.get("seed"), tenant=rec.get("tenant"),
+                        resume_emitted=rec["emitted"],
+                    )
+                except Exception:  # noqa: BLE001 — one lost resume ≠ a failed boot
+                    logger.exception("WAL resume failed (orig_rid=%s)",
+                                     rec["rid"])
+                    return
+                with lock:
+                    summary["results"][rec["rid"]] = toks
+
+            th = threading.Thread(target=_resume, daemon=True,
+                                  name=f"wal-restore-{rec['rid']}")
+            th.start()
+            threads.append(th)
+        if wait:
+            for th in threads:
+                th.join()
+        return summary
 
     def _pool_retier(self) -> None:
         """Cache→pool tier mirror (PrefixCache.on_retier): re-tag every
@@ -2610,6 +2787,7 @@ class WsgiApp:
                 Rule("/query", endpoint="generate", methods=["POST"]),
                 Rule("/index_info", endpoint="index_info", methods=["GET"]),
                 Rule("/healthz", endpoint="healthz", methods=["GET"]),
+                Rule("/drain", endpoint="drain", methods=["POST"]),
                 Rule("/metrics", endpoint="metrics", methods=["GET"]),
                 Rule("/slo", endpoint="slo", methods=["GET"]),
                 Rule("/profile", endpoint="profile", methods=["POST"]),
@@ -2857,7 +3035,13 @@ class WsgiApp:
         # drain the pod (503 here) but NOT restart it (?live=1 stays 200;
         # a restart would replay warmup into the same sick device)
         breaker_open = svc.breaker.open
-        ready = svc.ready and not breaker_open
+        # a draining lifecycle is the THIRD not-ready cause (ISSUE 19): the
+        # endpoints controller must stop routing new work here while the
+        # in-flight tail finishes — same 503-but-alive contract the open
+        # breaker uses, so the kubelet never restarts a pod mid-drain
+        lifecycle_draining = svc.lifecycle.draining
+        draining = (breaker_open and svc.ready) or lifecycle_draining
+        ready = svc.ready and not breaker_open and not lifecycle_draining
         live = bool(request.args.get("live"))
         body = {
             # ?live=1 is the LIVENESS form (deploy.yaml): 200 whenever the
@@ -2865,7 +3049,7 @@ class WsgiApp:
             # re-warming after an engine reset) must be not-ready, not dead,
             # or the kubelet would restart it into the same warmup
             "status": ("alive" if live else "ok") if (ready or live)
-            else ("draining" if breaker_open and svc.ready else "warming"),
+            else ("draining" if draining else "warming"),
             # fleet-dashboard segmentation fields (ISSUE 2 satellite)
             "uptime_s": round(time.monotonic() - svc.started_at, 1),
             "version": _package_version(),
@@ -2883,7 +3067,23 @@ class WsgiApp:
         body["ready"] = ready
         body["breaker_open"] = breaker_open
         body["breaker_recent_resets"] = svc.breaker.recent_resets()
+        body["draining"] = lifecycle_draining
         return self._jsonify(body, 200 if (ready or live) else 503)
+
+    def ep_drain(self, request):
+        """Begin a graceful drain (the deploy.yaml preStop hook's target;
+        also an operator's manual lever). Idempotent — a second POST
+        reports the drain already in progress. The response returns
+        immediately; the coordinator's watcher thread finishes the
+        in-flight tail, persists, and exits on its own schedule."""
+        lc = self.service.lifecycle
+        started = lc.begin_drain("http")
+        return self._jsonify({
+            "state": lc.state,
+            "started": started,
+            "active": self.service.admission.active,
+            "deadline_s": lc.deadline_s,
+        }, 202 if started else 200)
 
     def ep_metrics(self, request):
         """One scrape sees everything (obs/metrics.py): the request/stage/

@@ -187,6 +187,8 @@ def build_service():
 
 
 def main():
+    import signal
+
     from rag_llm_k8s_tpu.resilience import faults
     from rag_llm_k8s_tpu.server.app import create_app
 
@@ -195,8 +197,36 @@ def main():
     if service.store.ntotal == 0:
         logger.warning("No PDF files were processed. The index might be empty.")
 
-    # warm in the background so /healthz can report progress immediately
-    threading.Thread(target=service.warmup, daemon=True).start()
+    # crash-safe lifecycle (ISSUE 19): SIGTERM — every k8s roll, node
+    # drain, and reschedule — begins the graceful drain instead of killing
+    # decodes mid-stream. The coordinator's watcher finishes the in-flight
+    # tail, persists the WAL + warmth manifest, and THEN exits the
+    # process (os._exit: the dev WSGI server has no clean shutdown handle,
+    # and persist already ran — nothing atexit could add).
+    service.lifecycle.exit_fn = lambda: os._exit(0)
+    signal.signal(
+        signal.SIGTERM, lambda *_: service.lifecycle.begin_drain("sigterm")
+    )
+
+    def _warm_then_restore():
+        # warm in the background so /healthz can report progress
+        # immediately; the WAL restore pass runs AFTER warmup so the
+        # resumed submits execute on compiled paths (and after the dead
+        # epoch's WAL is on disk untouched — this incarnation appends to
+        # its own epoch only)
+        service.warmup()
+        try:
+            summary = service.restore_from_wal()
+            if summary["resumed"] or summary["skipped"]:
+                logger.info(
+                    "WAL restore: resumed=%d skipped=%d rehydrated=%d",
+                    summary["resumed"], summary["skipped"],
+                    summary["rehydrated"],
+                )
+        except Exception:  # noqa: BLE001 — a failed restore must not kill boot
+            logger.exception("WAL restore failed; serving cold")
+
+    threading.Thread(target=_warm_then_restore, daemon=True).start()
 
     # chaos/staging only: TPU_RAG_FAULTS arms named failure sites and
     # enables POST /debug/faults (no-op when the variable is absent).

@@ -161,6 +161,126 @@ def extract_trace(events: Iterable[Dict]) -> Dict:
     return {"schema_version": TRACE_SCHEMA_VERSION, "arrivals": arrivals}
 
 
+#: event types that END a request's lifecycle in a journal — a request
+#: whose chain lacks all of them was in flight when the recording stopped
+#: (i.e. when the process died, for a WAL epoch)
+_TERMINAL_EVENTS = ("complete", "evict")
+
+
+def extract_inflight(events: Iterable[Dict]) -> Dict:
+    """What a dead incarnation's WAL owes the next one: every request
+    with an ``arrival`` but no terminal event (``complete``, ``evict``,
+    or a gave-up ``resubmit``), each with the prompt the arrival recorded
+    (real ids, or the deterministic synthetic filler when the recording
+    kept lengths only) and the emitted-token stream rebuilt by
+    concatenating its ``token_emit`` deltas in seq order. The warm
+    restart (server/main.py) resubmits each record through the
+    scheduler's fold path; ``synthetic_prompt`` marks records a restart
+    should SKIP when byte-exactness matters (a synthetic prompt resumes
+    the shape, not the stream)."""
+    parsed = parse_journal(events)["events"]
+    arrivals: Dict[int, Dict] = {}
+    emitted: Dict[int, List[int]] = {}
+    terminal: Dict[int, str] = {}
+    for e in parsed:
+        rid = e.get("rid")
+        if rid is None:
+            continue
+        typ = e["type"]
+        if typ == "arrival" and rid not in arrivals:
+            arrivals[rid] = e
+        elif typ == "token_emit":
+            emitted.setdefault(rid, []).extend(
+                int(t) for t in e.get("toks", ())
+            )
+        elif typ in _TERMINAL_EVENTS:
+            terminal[rid] = typ
+        elif typ == "resubmit" and e.get("outcome") == "gave_up":
+            terminal[rid] = "gave_up"
+    inflight: List[Dict] = []
+    for rid in sorted(arrivals):
+        if rid in terminal:
+            continue
+        a = arrivals[rid]
+        rec: Dict = {
+            "rid": rid,
+            "prompt": _arrival_prompt(a),
+            "prompt_len": int(a.get("prompt_len", 0)),
+            "max_new": int(a.get("max_new", 1)),
+            "emitted": emitted.get(rid, []),
+            "synthetic_prompt": not bool(a.get("ids")),
+        }
+        for k in ("seed", "deadline_ms", "tenant", "session"):
+            if k in a:
+                rec[k] = a[k]
+        inflight.append(rec)
+    return {
+        "inflight": inflight,
+        "arrivals": len(arrivals),
+        "terminal": {
+            out: sum(1 for v in terminal.values() if v == out)
+            for out in sorted(set(terminal.values()))
+        },
+    }
+
+
+def build_restore_report(epochs: Dict[int, List[Dict]]) -> Dict:
+    """The ``flightview --restore-report`` payload over a scanned WAL
+    directory (``flight.scan_wal``'s ``{epoch: [events]}``): per epoch,
+    what the incarnation did (arrivals/completions/drain trail), what it
+    left in flight, and what the NEXT incarnation's restore pass actually
+    did about it (resumed / rehydrated / skipped — the ``restore`` and
+    ``outcome="restored"`` resubmit events it journaled)."""
+    report: Dict = {"epochs": []}
+    for epoch in sorted(epochs):
+        evs = parse_journal(epochs[epoch])["events"]
+        flight_state = extract_inflight(evs)
+        drain = [
+            {k: v for k, v in e.items() if k in
+             ("phase", "reason", "in_flight", "deadline_s", "timed_out")}
+            for e in evs if e["type"] == "drain"
+        ]
+        resumed, rehydrated, skipped = [], [], []
+        for e in evs:
+            if e["type"] == "restore":
+                phase = e.get("phase")
+                if phase == "resume":
+                    resumed.append({
+                        "rid": e.get("rid"),
+                        "orig_rid": e.get("orig_rid"),
+                        "orig_epoch": e.get("orig_epoch"),
+                        "n_emitted": int(e.get("n_emitted", 0)),
+                    })
+                elif phase == "rehydrate":
+                    rehydrated.append({
+                        "key": e.get("key"),
+                        "tokens": int(e.get("tokens", 0)),
+                    })
+                elif phase == "skip":
+                    skipped.append({
+                        "orig_rid": e.get("orig_rid"),
+                        "reason": e.get("reason"),
+                    })
+        completes = sum(1 for e in evs if e["type"] == "complete")
+        report["epochs"].append({
+            "epoch": epoch,
+            "events": len(evs),
+            "arrivals": flight_state["arrivals"],
+            "completes": completes,
+            "inflight_at_end": [
+                {"rid": r["rid"], "prompt_len": r["prompt_len"],
+                 "n_emitted": len(r["emitted"]),
+                 "synthetic_prompt": r["synthetic_prompt"]}
+                for r in flight_state["inflight"]
+            ],
+            "drain": drain,
+            "restored": resumed,
+            "rehydrated": rehydrated,
+            "skipped": skipped,
+        })
+    return report
+
+
 def _is_stall_window(e: Dict) -> bool:
     """A ``goodput_window`` whose whole duration is preempt churn (the
     ledger's ``record_preempt_stall``): a scheduler step that opened no

@@ -100,6 +100,12 @@ REQUIRED_KEYS = (
     # B=8 continuous decode; acceptance ≤ 2%) — attribution is ON by
     # default, so its overhead may never go unjudged in a bench round
     "tenant_overhead.overhead_frac",
+    # ISSUE 19: warm restart's measured benefit — the fraction of the
+    # cold first-burst's first-touch prefill tokens the warmth-manifest
+    # rehydration makes unnecessary (regression.classify tracks
+    # "reduction" higher-is-better). A silently dropped leg must fail
+    # the gate, not read as "restart warmth unjudged"
+    "restart_warmth.warm_prefill_reduction",
 )
 
 

@@ -30,7 +30,13 @@ copied off a pod's spool directory) — or a bare journal dump — into:
   divergent decision, per-event-type count deltas, occupancy deltas —
   via rag_llm_k8s_tpu/sim/replay.py (same jax-free contract). This is
   how a ``make replay-smoke`` failure or a live-vs-simulated run is
-  triaged (docs/REPLAY.md).
+  triaged (docs/REPLAY.md);
+- **the restore report** (``--restore-report``): the warm-restart
+  post-mortem over a flight-WAL directory copied off the pod's PVC —
+  per epoch (one per process incarnation), what died in flight and what
+  the next incarnation's restore pass resumed, rehydrated, or skipped
+  (sim/replay.py ``build_restore_report``, same jax-free contract;
+  docs/RESILIENCE.md "Crash-safe lifecycle").
 
 No live pod, no jax, no third-party deps — a bundle is self-contained by
 contract (docs/OBSERVABILITY.md "Engine flight recorder").
@@ -43,6 +49,7 @@ Usage:
     python scripts/flightview.py BUNDLE.json --quality
     python scripts/flightview.py BUNDLE.json --tenants [--chip-hour-usd X]
     python scripts/flightview.py RECORDED.json --replay-diff REPLAYED.json
+    python scripts/flightview.py WAL_DIR/ --restore-report
 
 Input shapes accepted: a full incident bundle (``{"journal": [...],
 "trigger": ..., ...}``), a journal-only dump (``{"journal": [...]}``), or
@@ -300,6 +307,84 @@ def render_replay_diff_ascii(diff: Dict, name_a: str, name_b: str) -> str:
     return "\n".join(lines)
 
 
+def build_restore_report(path: str) -> Dict:
+    """The warm-restart post-mortem (``--restore-report``): per WAL epoch,
+    what that incarnation did, what it left in flight at death, and what
+    the next incarnation's restore pass did about it (resumed /
+    rehydrated / skipped) — sim/replay.py's ``build_restore_report`` over
+    ``obs/flight.py``'s ``scan_wal``. ``path`` may be a WAL *directory*
+    (the usual case: copied off the pod's PVC) or a single journal/bundle
+    file (rendered as one epoch)."""
+    rp = _load_sim_module("replay")
+    if os.path.isdir(path):
+        fl = _load_obs_module("flight")
+        epochs = fl.scan_wal(path)
+        if not epochs:
+            raise SystemExit(
+                f"flightview: no WAL segments (wal_*.jsonl) under {path}"
+            )
+    else:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"flightview: cannot read {path}: {e}")
+        epochs = {0: load_events(doc)}
+    return rp.build_restore_report(epochs)
+
+
+def render_restore_ascii(report: Dict) -> str:
+    lines = ["restore report  (one section per WAL epoch = one "
+             "process incarnation)"]
+    for ep in report["epochs"]:
+        lines.append("")
+        lines.append(
+            f"epoch {ep['epoch']}  events={ep['events']}"
+            f"  arrivals={ep['arrivals']}  completes={ep['completes']}"
+        )
+        for d in ep["drain"]:
+            attrs = " ".join(
+                f"{k}={v}" for k, v in d.items() if k != "phase"
+            )
+            lines.append(f"  drain {d.get('phase'):<9} {attrs}")
+        inflight = ep["inflight_at_end"]
+        if inflight:
+            lines.append(
+                f"  in flight at death ({len(inflight)}):"
+            )
+            for r in inflight:
+                syn = "  [synthetic prompt]" if r["synthetic_prompt"] else ""
+                lines.append(
+                    f"    rid={r['rid']:<6} prompt_len={r['prompt_len']:<6}"
+                    f" emitted={r['n_emitted']}{syn}"
+                )
+        else:
+            lines.append("  in flight at death: none (clean exit)")
+        if ep["restored"]:
+            lines.append(f"  resumed here ({len(ep['restored'])}):")
+            for r in ep["restored"]:
+                # the restore event precedes the resumed submit, so the
+                # NEW rid may be unknown (None) — the original identity
+                # is the meaningful one
+                lines.append(
+                    f"    epoch {r['orig_epoch']} rid={r['orig_rid']}"
+                    f"  folded {r['n_emitted']} tokens"
+                )
+        if ep["rehydrated"]:
+            toks = sum(r["tokens"] for r in ep["rehydrated"])
+            lines.append(
+                f"  cache rehydrated: {len(ep['rehydrated'])} segments,"
+                f" {toks} tokens pre-staged"
+            )
+        if ep["skipped"]:
+            lines.append(f"  skipped ({len(ep['skipped'])}):")
+            for r in ep["skipped"]:
+                lines.append(
+                    f"    orig_rid={r['orig_rid']}  reason={r['reason']}"
+                )
+    return "\n".join(lines)
+
+
 def build_quality_report(events: List[Dict]) -> Dict:
     """The offline half of the quality same-report contract: rebuild the
     auditor state from ``shadow_audit`` events and render with the exact
@@ -449,7 +534,22 @@ def main(argv=None) -> int:
                          "against OTHER's (a replayed or simulated "
                          "journal): first divergence, per-event-type "
                          "count deltas, occupancy deltas")
+    ap.add_argument("--restore-report", action="store_true",
+                    help="render the warm-restart post-mortem: per WAL "
+                         "epoch, what died in flight and what the next "
+                         "incarnation resumed/rehydrated/skipped. BUNDLE "
+                         "may be a WAL directory (wal_*.jsonl) or a "
+                         "journal file")
     args = ap.parse_args(argv)
+    if args.restore_report:
+        # dispatched before the generic json.load: the input is usually a
+        # WAL *directory*, not a bundle file
+        report = build_restore_report(args.bundle)
+        if args.as_json:
+            print(json.dumps(report, indent=1))
+        else:
+            print(render_restore_ascii(report))
+        return 0
     try:
         with open(args.bundle) as f:
             doc = json.load(f)

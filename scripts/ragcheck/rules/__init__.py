@@ -9,6 +9,7 @@ from scripts.ragcheck.rules.metric_drift import MetricDriftRule
 from scripts.ragcheck.rules.event_registry import EventRegistryRule
 from scripts.ragcheck.rules.debug_gate import DebugGateRule
 from scripts.ragcheck.rules.sim_purity import SimPurityRule
+from scripts.ragcheck.rules.durable_write import DurableWriteRule
 
 ALL_RULES = [
     LockDisciplineRule,
@@ -20,6 +21,7 @@ ALL_RULES = [
     EventRegistryRule,
     DebugGateRule,
     SimPurityRule,
+    DurableWriteRule,
 ]
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,117 @@
+"""DURABLE-WRITE: crash-consistent persistence goes through durable_write.
+
+The modules that persist state a NEXT process incarnation reads — the
+incident spooler's bundles, the flight WAL's warmth manifest, the drain
+coordinator's persist step — must survive SIGKILL at any instruction.
+The one discipline that guarantees it is :func:`obs.flight.durable_write`:
+write a temp file, flush, fsync, ``os.replace`` over the target, fsync
+the directory. A reader then sees the old content or the new content,
+never a torn half-file (ISSUE 19; docs/RESILIENCE.md "Crash-safe
+lifecycle").
+
+This rule pins the discipline structurally in the writer modules: any
+*write-mode* ``open(...)`` (``"w"``/``"x"``) and any bare ``os.replace``
+outside the body of ``durable_write`` itself is flagged — a raw write is
+exactly the torn-file window the helper exists to close. Append-mode
+opens are exempt: the WAL's segment appends are a different durability
+design (one fsync'd JSON line per event; a torn TAIL line is detected
+and skipped by ``scan_wal``), and rewriting them through a full-file
+replace would turn O(1) appends into O(n) rewrites.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from scripts.ragcheck.core import Finding, Repo, dotted_name
+
+PACKAGE = "rag_llm_k8s_tpu"
+
+#: modules that persist cross-incarnation state (spool, WAL, manifests).
+#: Extend this tuple when a new module starts writing durable files.
+WRITER_MODULES = (
+    f"{PACKAGE}/obs/flight.py",
+    f"{PACKAGE}/resilience/lifecycle.py",
+)
+
+#: the one function allowed to perform the raw tmp-write + os.replace
+HELPER = "durable_write"
+
+_WRITE_MODES = ("w", "x")
+
+
+def _open_write_mode(call: ast.Call) -> Optional[str]:
+    """The mode string when ``call`` is a write-mode builtin open()."""
+    if not (isinstance(call.func, ast.Name) and call.func.id == "open"):
+        return None
+    mode: Optional[ast.AST] = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not isinstance(mode, ast.Constant) or not isinstance(mode.value, str):
+        return None  # no/dynamic mode: a read, or undecidable — not flagged
+    if any(c in mode.value for c in _WRITE_MODES):
+        return mode.value
+    return None
+
+
+class DurableWriteRule:
+    id = "DURABLE-WRITE"
+
+    def run(self, repo: Repo) -> Iterable[Finding]:
+        for rel in WRITER_MODULES:
+            sf = repo.get(rel)
+            if sf is None or sf.tree is None:
+                continue
+            for call, func_stack in _calls_with_scope(sf.tree):
+                enclosing = func_stack[-1] if func_stack else "<module>"
+                if HELPER in func_stack:
+                    continue  # the helper's own tmp-write + replace
+                qual = ".".join(func_stack) or "<module>"
+                mode = _open_write_mode(call)
+                if mode is not None:
+                    yield Finding(
+                        rule=self.id, path=sf.path, line=call.lineno,
+                        message=(
+                            f"raw write-mode open(mode={mode!r}) in "
+                            f"{enclosing}() of a durable-state writer "
+                            "module — a crash mid-write leaves a torn "
+                            f"file; route it through {HELPER}() "
+                            "(tmp → fsync → rename)"
+                        ),
+                        key=f"raw-open:{qual}:{mode}",
+                    )
+                elif dotted_name(call.func) == "os.replace":
+                    yield Finding(
+                        rule=self.id, path=sf.path, line=call.lineno,
+                        message=(
+                            f"bare os.replace in {enclosing}() — a rename "
+                            "without the preceding tmp-file fsync (and the "
+                            "directory fsync after) is not crash-durable; "
+                            f"use {HELPER}()"
+                        ),
+                        key=f"raw-replace:{qual}",
+                    )
+
+
+def _calls_with_scope(
+    tree: ast.AST,
+) -> Iterable[Tuple[ast.Call, List[str]]]:
+    """Every Call node paired with its enclosing def-name stack (class
+    names excluded — the exemption keys on FUNCTION identity)."""
+    out: List[Tuple[ast.Call, List[str]]] = []
+
+    def walk(node: ast.AST, stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(child, stack + [child.name])
+            else:
+                if isinstance(child, ast.Call):
+                    out.append((child, stack))
+                walk(child, stack)
+
+    walk(tree, [])
+    return out

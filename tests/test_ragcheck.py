@@ -28,6 +28,7 @@ from scripts.ragcheck.rules.jit_hygiene import JitHygieneRule  # noqa: E402
 from scripts.ragcheck.rules.lock_discipline import LockDisciplineRule  # noqa: E402
 from scripts.ragcheck.rules.metric_drift import MetricDriftRule  # noqa: E402
 from scripts.ragcheck.rules.sharding_contract import ShardingContractRule  # noqa: E402
+from scripts.ragcheck.rules.durable_write import DurableWriteRule  # noqa: E402
 from scripts.ragcheck.rules.sim_purity import SimPurityRule  # noqa: E402
 
 BASELINE = REPO_ROOT / "scripts" / "ragcheck" / "baseline.json"
@@ -752,6 +753,74 @@ class TestSimPurity:
         # the package into a path-loaded module)
         _, findings = core.run_analysis(
             str(REPO_ROOT), rules=[SimPurityRule()]
+        )
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# DURABLE-WRITE
+# ---------------------------------------------------------------------------
+
+
+class TestDurableWrite:
+    def test_flags_raw_write_and_bare_replace(self, tmp_path):
+        fs = run_rule(tmp_path, DurableWriteRule, {
+            "rag_llm_k8s_tpu/obs/flight.py": """
+                import json
+                import os
+
+                def save_manifest(path, doc):
+                    with open(path, "w") as f:
+                        json.dump(doc, f)
+
+                def swap(tmp, path):
+                    os.replace(tmp, path)
+                """,
+        })
+        assert keys(fs) == {
+            "raw-open:save_manifest:w",
+            "raw-replace:swap",
+        }
+        assert all(f.rule == "DURABLE-WRITE" for f in fs)
+
+    def test_compliant_twin_is_silent(self, tmp_path):
+        # the helper itself owns the tmp-write + replace; append-mode
+        # (the WAL's per-event fsync discipline) and reads are exempt,
+        # and modules outside the writer set are not held to the rule
+        fs = run_rule(tmp_path, DurableWriteRule, {
+            "rag_llm_k8s_tpu/obs/flight.py": """
+                import json
+                import os
+
+                def durable_write(path, obj):
+                    tmp = path + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(obj, f)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(tmp, path)
+
+                def append_event(path, line):
+                    with open(path, "a") as f:
+                        f.write(line)
+
+                def load(path):
+                    with open(path) as f:
+                        return json.load(f)
+                """,
+            "rag_llm_k8s_tpu/engine/other.py": """
+                def scratch(path):
+                    with open(path, "w") as f:
+                        f.write("not durable state")
+                """,
+        })
+        assert fs == []
+
+    def test_repo_writer_modules_are_compliant(self):
+        # the real tree holds the discipline — a finding here means a raw
+        # write-mode open or bare os.replace crept into a writer module
+        _, findings = core.run_analysis(
+            str(REPO_ROOT), rules=[DurableWriteRule()]
         )
         assert findings == [], "\n".join(f.render() for f in findings)
 
