@@ -192,6 +192,18 @@ drain-smoke:
 restart-smoke:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_lifecycle.py::TestCrashRestartChaos tests/test_lifecycle.py::TestServiceRestore -q -p no:cacheprovider
 
+# Disaggregation smoke (ISSUE 20, docs/ROUTER.md): greedy AND seeded
+# streams through a routed prefill->decode pair must be BYTE-IDENTICAL
+# to a unified engine (the hand-off moves KV blocks, sampling keys, and
+# the kv frontier without perturbing a single draw), the journal must
+# carry matched migrate_begin/migrate_done pairs, affinity routing must
+# be non-vacuous, and the simulator must size both tiers from a trace.
+# tp=2 identity and the mid-migration chaos reset ride `make chaos` +
+# tier1 (tests/test_router.py::TestDisaggTP2,
+# tests/test_resilience.py::TestMigrationChaos).
+disagg-smoke:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_router.py::TestSmoke -q -p no:cacheprovider
+
 # Perf regression gate (scripts/bench_gate.py): compare a fresh bench JSON
 # against a committed baseline with per-metric tolerance bands, direction
 # aware (latency up = bad, tok/s down = bad). Defaults to comparing the
@@ -253,7 +265,7 @@ check: test tpu-test bench
 # (validates the baseline + gate plumbing without running the bench — the
 # TPU-judged comparison is `make bench` followed by
 # `make bench-gate BENCH_CURRENT=...`).
-ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke interleave-smoke flight-smoke goodput-smoke shadow-smoke replay-smoke tenants-smoke drain-smoke restart-smoke lint analyze
+ci: tier1 chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke interleave-smoke flight-smoke goodput-smoke shadow-smoke replay-smoke tenants-smoke drain-smoke restart-smoke disagg-smoke lint analyze
 	python scripts/bench_gate.py --baseline $(BENCH_BASELINE) --dry-run
 
-.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke interleave-smoke flight-smoke goodput-smoke shadow-smoke replay-smoke tenants-smoke drain-smoke restart-smoke ci lint analyze check validate-8b validate-70b
+.PHONY: test tier1 tpu-test bench bench-gate chaos tp2-smoke lookahead-smoke tiering-smoke splice-smoke spec-smoke interleave-smoke flight-smoke goodput-smoke shadow-smoke replay-smoke tenants-smoke drain-smoke restart-smoke disagg-smoke ci lint analyze check validate-8b validate-70b

@@ -1183,6 +1183,241 @@ def measure_chunk_reuse() -> dict:
     }
 
 
+def measure_disagg() -> dict:
+    """Disaggregated prefill/decode pools + affinity routing (ISSUE 20
+    acceptance leg, docs/ROUTER.md). Two halves:
+
+    **Affinity** — the same shuffled-composition stream as the
+    ``chunk_reuse`` leg (one head + 3 chunks drawn from a 6-chunk hot
+    set, order permuted) resolved against TWO replica-local chunk caches,
+    with the composition→replica decision made by ``Router.select``.
+    Acceptance: the fleet's aggregate ``prefill_skip_frac`` under
+    affinity routing must not fall below the single-replica leg's —
+    routing repeat compositions to the replica already holding their KV
+    is what keeps chunk reuse a fleet property instead of halving it.
+    A round-robin split of the same stream is reported as the contrast
+    (what a dumb L2 balancer does to the cache).
+
+    **Cost** — the same concurrent workload through a unified engine
+    (one chip) and a routed prefill+decode pair (two chips): per-request
+    p95 and ``tokens_per_usd`` at a pinned synthetic price, with
+    ``tokens_per_usd_ratio`` (disagg / unified) the gated headline
+    (``bench_gate`` REQUIRED_KEYS; ``regression.classify`` judges
+    tokens_per_usd higher-is-better). On this CPU tiny config the two
+    tiers buy no hardware asymmetry, so the ratio prices the split's
+    overhead (two rentals for one stream + the migration copy); on real
+    mixed-generation hardware the same arithmetic prices the win. The
+    routed streams are also pinned byte-identical to the unified run."""
+    import dataclasses
+    import itertools
+    import threading
+
+    import jax
+    import numpy as np
+
+    from rag_llm_k8s_tpu.core.config import (
+        DTypePolicy,
+        EngineConfig,
+        LlamaConfig,
+        PrefixCacheConfig,
+        RouterConfig,
+        SamplingConfig,
+    )
+    from rag_llm_k8s_tpu.engine.continuous import (
+        ContinuousEngine,
+        ContinuousScheduler,
+    )
+    from rag_llm_k8s_tpu.engine.engine import InferenceEngine
+    from rag_llm_k8s_tpu.engine.prefix_cache import PrefixCache
+    from rag_llm_k8s_tpu.models.llama import init_llama_params
+    from rag_llm_k8s_tpu.server.router import Replica, Router
+
+    fp32 = DTypePolicy.fp32()
+    cfg = LlamaConfig.tiny(vocab_size=128)
+    params = init_llama_params(jax.random.PRNGKey(0), cfg, fp32)
+
+    # -- affinity: fleet-level chunk reuse under routed compositions -------
+    cache_cfg = PrefixCacheConfig(
+        enabled=True, max_prefix_tokens=64, segment_buckets=(16,),
+        suffix_buckets=(16,), hbm_budget_mb=64, reuse="chunk",
+        boundary_tokens=4, chunk_hot_min=0.0,
+    )
+    aff_engine = InferenceEngine(
+        cfg, params,
+        sampling=SamplingConfig(do_sample=False, max_new_tokens=4),
+        engine_config=EngineConfig(
+            prompt_buckets=(64,), max_batch_size=2, speculative="off",
+            max_seq_len=128, prefix_cache=cache_cfg,
+        ),
+        dtypes=fp32,
+    )
+    rng = np.random.default_rng(0)
+    head = [int(cfg.bos_token_id)] + list(map(int, rng.integers(3, 120, 15)))
+    chunks = {
+        f"chunk:{i}": list(map(int, rng.integers(3, 120, 16)))
+        for i in range(6)
+    }
+    orders = list(itertools.permutations(sorted(chunks), 3))
+    rng.shuffle(orders)
+    stream = [
+        [("head", head)] + [(k, chunks[k]) for k in keys]
+        for keys in orders[:24]
+    ]
+
+    def skip_frac(route):
+        """Resolve the stream with ``route(i, chunk_names) -> cache``;
+        return the aggregate prefill skip fraction across all caches."""
+        caches = {}
+        for i, segs in enumerate(stream):
+            cache = route(i, [k for k, _ in segs[1:]], caches)
+            cache.prefix_for(segs)
+        reused = sum(c.tokens_reused for c in caches.values())
+        computed = sum(c.tokens_computed for c in caches.values())
+        return round(reused / max(reused + computed, 1), 3)
+
+    def cache_for(caches, name):
+        if name not in caches:
+            caches[name] = PrefixCache(cache_cfg, aff_engine)
+        return caches[name]
+
+    # the routed fleet: two prefill-tier replica stubs with equal load,
+    # the real Router doing the scoring (self-reinforcing affinity)
+    class _Eng:
+        pool_role, B, kv_pool = "prefill", 4, None
+
+        def free_slots(self):
+            return [0, 1, 2, 3]
+
+    class _Sched:
+        def __init__(self):
+            self.engine, self._stop = _Eng(), threading.Event()
+
+    router = Router([Replica("rep-a", _Sched()), Replica("rep-b", _Sched())],
+                    RouterConfig())
+    hits = [0]
+
+    def route_affinity(i, names, caches):
+        rep, _, aff = router.select("prefill", chunk_keys=names)
+        hits[0] += aff > 0.0
+        return cache_for(caches, rep.name)
+
+    affinity_frac = skip_frac(route_affinity)
+    single_frac = skip_frac(lambda i, names, c: cache_for(c, "solo"))
+    rr_frac = skip_frac(lambda i, names, c: cache_for(c, f"rr-{i % 2}"))
+    del aff_engine
+
+    # -- cost: unified chip vs routed prefill+decode pair ------------------
+    sampling = SamplingConfig(do_sample=False, max_new_tokens=8)
+    paged = EngineConfig(
+        prompt_buckets=(16, 32), max_batch_size=4, max_seq_len=64,
+        kv_paged=True, kv_block_size=16,
+    )
+    shapes = [[5, 6, 7, 8, 9, 10, 11], [12, 13, 14], [3] * 20, [9] * 25]
+    n_req, n_threads = 12, 4
+    prompts = [shapes[i % len(shapes)] for i in range(n_req)]
+    chip_hour_usd = 1.0  # pinned synthetic price: ratios are what matter
+
+    def run_tier(submit, n_chips):
+        # untimed warm-up (one prompt per bucket): the tiers trace their
+        # executables outside the measured window, so p95 prices serving,
+        # not compilation
+        submit(shapes[0])
+        submit(shapes[3])
+        lat, outs, lock = [], {}, threading.Lock()
+
+        def worker(ids):
+            for i in ids:
+                t0 = time.monotonic()
+                toks = submit(prompts[i])
+                dt = time.monotonic() - t0
+                with lock:
+                    lat.append(dt)
+                    outs[i] = toks
+        threads = [
+            threading.Thread(target=worker, args=(range(t, n_req, n_threads),))
+            for t in range(n_threads)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        tokens = sum(len(v) for v in outs.values())
+        usd = wall * n_chips * chip_hour_usd / 3600.0
+        return {
+            "chips": n_chips,
+            "wall_s": round(wall, 3),
+            "tokens": tokens,
+            "p50_ms": round(_pctl(lat, 0.50) * 1e3, 1),
+            "p95_ms": round(_pctl(lat, 0.95) * 1e3, 1),
+            "tokens_per_usd": round(tokens / usd, 1) if usd > 0 else 0.0,
+        }, outs
+
+    def _pctl(vals, q):
+        s = sorted(vals)
+        return s[min(len(s) - 1, int(q * len(s)))] if s else 0.0
+
+    uni = ContinuousScheduler(
+        ContinuousEngine(cfg, params, sampling=sampling, engine_config=paged,
+                         dtypes=fp32),
+        retry_backoff_s=0.0,
+    )
+    try:
+        uni_stats, uni_outs = run_tier(lambda p: uni.submit(p), 1)
+    finally:
+        uni.shutdown()
+
+    pre = ContinuousScheduler(
+        ContinuousEngine(
+            cfg, params, sampling=sampling,
+            engine_config=dataclasses.replace(paged, pool_role="prefill"),
+            dtypes=fp32,
+        ),
+        retry_backoff_s=0.0,
+    )
+    dec = ContinuousScheduler(
+        ContinuousEngine(
+            cfg, params, sampling=sampling,
+            engine_config=dataclasses.replace(paged, pool_role="decode"),
+            dtypes=fp32,
+        ),
+        retry_backoff_s=0.0,
+    )
+    tier = Router([Replica("bench-p0", pre), Replica("bench-d0", dec)])
+    try:
+        pair_stats, pair_outs = run_tier(lambda p: tier.submit(p), 2)
+        leaked = (pre.engine.kv_pool.blocks_in_use()
+                  + dec.engine.kv_pool.blocks_in_use())
+    finally:
+        pre.shutdown()
+        dec.shutdown()
+
+    uni_tpu = uni_stats["tokens_per_usd"]
+    return {
+        "disagg": {
+            "queries": len(stream),
+            # the acceptance comparison: routed fleet reuse vs the
+            # single-replica chunk_reuse leg's number on the SAME stream
+            "affinity_skip_frac": affinity_frac,
+            "single_replica_skip_frac": single_frac,
+            "round_robin_skip_frac": rr_frac,
+            "affinity_ge_single": affinity_frac >= single_frac,
+            "affinity_hit_rate": round(hits[0] / len(stream), 3),
+            "requests": n_req,
+            "concurrency": n_threads,
+            "chip_hour_usd": chip_hour_usd,
+            "unified": uni_stats,
+            "pair": pair_stats,
+            "streams_identical": pair_outs == uni_outs,
+            "leaked_blocks": leaked,
+            "tokens_per_usd_ratio": round(
+                pair_stats["tokens_per_usd"] / uni_tpu, 3
+            ) if uni_tpu else 0.0,
+        }
+    }
+
+
 def measure_restart_warmth() -> dict:
     """Warm-restart prefill warmth (ISSUE 19 acceptance leg): first-burst
     prefix-resolve cost on a freshly restarted replica, cold vs
@@ -3378,6 +3613,7 @@ def bench_legs(line: dict):
         ("lookahead_overlap", lambda: line.update(measure_lookahead_overlap())),
         ("kv_tiering", lambda: line.update(measure_kv_tiering())),
         ("chunk_reuse", lambda: line.update(measure_chunk_reuse())),
+        ("disagg", lambda: line.update(measure_disagg())),
         ("flight_overhead", lambda: line.update(measure_flight_overhead())),
         ("goodput_overhead", lambda: line.update(measure_goodput_overhead())),
         ("shadow_overhead", lambda: line.update(measure_shadow_overhead())),

@@ -18,15 +18,27 @@ The gate also fronts the circuit breaker: while the breaker is open the pod
 is draining, so new work is shed with 503 + ``Retry-After`` equal to the
 breaker's estimated close time.
 
-``rag_admission_rejected_total{reason}`` counts every shed request; the
-live ``waiting`` count folds into ``rag_admission_queue_depth``.
+``rag_admission_rejected_total{reason, tenant}`` counts every shed
+request; the live ``waiting`` count folds into
+``rag_admission_queue_depth``.
+
+Tenant-aware fair share (ISSUE 20): when the queue is FULL, an arriving
+tenant under its fair share of the gate (capacity / tenants present) may
+displace the newest queued waiter of a tenant OVER its share — that
+waiter sheds with reason="fair_share" and the newcomer takes its place.
+One tenant's burst can no longer monopolize the whole queue; tenants
+below their share still get queued even at saturation. Tenant values
+arrive pre-interned through the edge's TenantTracker (tracked or
+``__other__``), so every per-tenant structure here is cardinality-bounded
+by construction. Requests with no tenant never displace and are never
+displaced — tenancy off keeps the exact pre-fair-share behavior.
 """
 
 from __future__ import annotations
 
 import threading
 from contextlib import contextmanager
-from typing import Optional
+from typing import Dict, List, Optional
 
 from rag_llm_k8s_tpu.obs import flight
 from rag_llm_k8s_tpu.resilience.breaker import CircuitBreaker
@@ -71,6 +83,13 @@ class AdmissionController:
         # rolling replica stops taking work without dropping work
         self._draining = False
         self._drain_retry_after_s = retry_after_s
+        # fair-share state (all under _cv): in-gate count per tenant
+        # (active + waiting) and one record per queued waiter, queue
+        # order — the displacement victim search walks it newest-first.
+        # Bounded: tenants arrive interned (top-K + "__other__"), waiters
+        # by max_queue.
+        self._tenant_gate: Dict[str, int] = {}
+        self._waiters: List[dict] = []
         # set by the service (obs wiring): labeled-counter families for
         # rag_admission_rejected_total / rag_deadline_exceeded_total —
         # None keeps the gate standalone
@@ -109,7 +128,10 @@ class AdmissionController:
                 tenant: Optional[str] = None):
         fam = self.reject_counter
         if fam is not None:
-            fam.labels(reason=reason).inc()
+            # tenant label values are pre-interned at the edge (tracked
+            # or "__other__"), so the series count stays bounded at
+            # reasons x (top-K + 1) even under adversarial tenant ids
+            fam.labels(reason=reason, tenant=tenant or "__other__").inc()
         if tenant is not None:
             tfam = self.tenant_shed_counter
             if tfam is not None:
@@ -138,45 +160,114 @@ class AdmissionController:
                 tenant=tenant,
             )
         with self._cv:
-            if self.active < self.max_concurrency and self.waiting == 0:
-                self.active += 1
-                return
-            if self.waiting >= self.max_queue:
+            if tenant is not None:
+                self._tenant_gate[tenant] = (
+                    self._tenant_gate.get(tenant, 0) + 1
+                )
+            try:
+                self._acquire_locked(deadline, tenant)
+            except BaseException:
+                # every rejection path gives the in-gate count back; a
+                # SUCCESSFUL acquire keeps it until _release(tenant)
+                self._gate_dec_locked(tenant)
+                raise
+
+    def _gate_dec_locked(self, tenant: Optional[str]) -> None:
+        if tenant is None:
+            return
+        c = self._tenant_gate.get(tenant, 0) - 1
+        if c <= 0:
+            self._tenant_gate.pop(tenant, None)
+        else:
+            self._tenant_gate[tenant] = c
+
+    def _fair_share_victim(self, tenant: Optional[str]) -> Optional[dict]:
+        """With the queue full: may this arrival displace a queued waiter?
+        Only when the arriving tenant sits UNDER its fair share of the
+        whole gate (capacity / tenants present, the classic max-min
+        bound) while some waiter's tenant sits OVER its own — then the
+        most-over-share tenant's NEWEST waiter is the victim (newest
+        first mirrors the engine's preemption discipline: the least
+        sunk-cost work yields). Returns the victim's record, or None
+        (the arrival sheds as plain queue_full). Caller holds _cv."""
+        if tenant is None or not self._waiters:
+            return None
+        present = set(self._tenant_gate)
+        present.add(tenant)
+        share = (self.max_concurrency + self.max_queue) / len(present)
+        if self._tenant_gate.get(tenant, 0) > share:
+            # the arrival itself is over-share (its own count includes
+            # this very request): no displacement — it sheds
+            return None
+        victim, victim_count = None, share
+        for rec in reversed(self._waiters):
+            t = rec["tenant"]
+            if t is None or t == tenant or rec["shed"]:
+                continue
+            c = self._tenant_gate.get(t, 0)
+            if c > victim_count:
+                victim, victim_count = rec, c
+        return victim
+
+    def _acquire_locked(self, deadline: Optional[Deadline],
+                        tenant: Optional[str]) -> None:
+        if self.active < self.max_concurrency and self.waiting == 0:
+            self.active += 1
+            return
+        if self.waiting >= self.max_queue:
+            victim = self._fair_share_victim(tenant)
+            if victim is None:
                 self._reject("queue_full", 429, self.retry_after_s,
                              tenant=tenant)
-            hint = self.saturation_hint
-            if hint is not None and hint():
-                rec = self.reclaimable_hint
-                if rec is None or not rec():
-                    self._reject("pool_exhausted", 429, self.retry_after_s,
+            # displace: the victim wakes, sees its shed mark and rejects
+            # itself with reason="fair_share"; this arrival queues in its
+            # place (waiting transiently overshoots max_queue by one
+            # until the victim unwinds — bounded, never cumulative)
+            victim["shed"] = True
+            self._cv.notify_all()
+        hint = self.saturation_hint
+        if hint is not None and hint():
+            rec = self.reclaimable_hint
+            if rec is None or not rec():
+                self._reject("pool_exhausted", 429, self.retry_after_s,
+                             tenant=tenant)
+            # else: the pool is full of demotable cache warmth — the
+            # scheduler reclaims it on its next sweep, so this request
+            # waits its bounded turn instead of bouncing a 429
+        wrec = {"tenant": tenant, "shed": False}
+        self._waiters.append(wrec)
+        self.waiting += 1
+        try:
+            while self.active >= self.max_concurrency:
+                if wrec["shed"]:
+                    # displaced by an under-share tenant's arrival (the
+                    # fair-share branch above): this waiter sheds so the
+                    # queue slot changes hands
+                    self._reject("fair_share", 429, self.retry_after_s,
                                  tenant=tenant)
-                # else: the pool is full of demotable cache warmth — the
-                # scheduler reclaims it on its next sweep, so this request
-                # waits its bounded turn instead of bouncing a 429
-            self.waiting += 1
-            try:
-                while self.active >= self.max_concurrency:
-                    if self._draining:
-                        # a drain beginning while we queued: shed NOW —
-                        # queued work is exactly what a drain refuses to
-                        # start (_reject's raise unwinds through finally)
-                        self._reject("draining", 503,
-                                     self._drain_retry_after_s, tenant=tenant)
-                    if deadline is not None:
-                        if deadline.expired():
-                            fam = self.deadline_counter
-                            if fam is not None:
-                                fam.labels(stage="queue").inc()
-                            raise DeadlineExceeded("queue", deadline.budget_ms)
-                        self._cv.wait(timeout=deadline.wait_timeout())
-                    else:
-                        self._cv.wait()
-                self.active += 1
-            finally:
-                self.waiting -= 1
+                if self._draining:
+                    # a drain beginning while we queued: shed NOW —
+                    # queued work is exactly what a drain refuses to
+                    # start (_reject's raise unwinds through finally)
+                    self._reject("draining", 503,
+                                 self._drain_retry_after_s, tenant=tenant)
+                if deadline is not None:
+                    if deadline.expired():
+                        fam = self.deadline_counter
+                        if fam is not None:
+                            fam.labels(stage="queue").inc()
+                        raise DeadlineExceeded("queue", deadline.budget_ms)
+                    self._cv.wait(timeout=deadline.wait_timeout())
+                else:
+                    self._cv.wait()
+            self.active += 1
+        finally:
+            self.waiting -= 1
+            self._waiters.remove(wrec)
 
-    def _release(self) -> None:
+    def _release(self, tenant: Optional[str] = None) -> None:
         with self._cv:
+            self._gate_dec_locked(tenant)
             self.active -= 1
             self._cv.notify()
 
@@ -196,7 +287,7 @@ class AdmissionController:
         try:
             yield
         finally:
-            self._release()
+            self._release(tenant)
 
     def queue_depth(self) -> int:
         """Requests currently waiting at the gate (for the depth gauge)."""

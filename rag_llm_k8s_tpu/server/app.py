@@ -663,10 +663,13 @@ class RagService:
         self._m_adm_rejected = reg.labeled_counter(
             "rag_admission_rejected_total",
             "requests shed at the admission gate (reason: queue_full | "
-            "breaker_open | pool_exhausted)",
+            "breaker_open | pool_exhausted | fair_share | draining; "
+            "tenant: edge-interned, so the series count stays bounded "
+            "at reasons x (top-K tenants + __other__))",
         )
-        for r in ("queue_full", "breaker_open", "pool_exhausted"):
-            self._m_adm_rejected.labels(reason=r)
+        for r in ("queue_full", "breaker_open", "pool_exhausted",
+                  "fair_share"):
+            self._m_adm_rejected.labels(reason=r, tenant="__other__")
         self.admission.reject_counter = self._m_adm_rejected
         self._m_deadline = reg.labeled_counter(
             "rag_deadline_exceeded_total",

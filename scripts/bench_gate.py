@@ -106,6 +106,12 @@ REQUIRED_KEYS = (
     # "reduction" higher-is-better). A silently dropped leg must fail
     # the gate, not read as "restart warmth unjudged"
     "restart_warmth.warm_prefill_reduction",
+    # ISSUE 20: disaggregated prefill/decode pools — tokens-per-dollar of
+    # the routed pair over the unified baseline on the same concurrent
+    # workload (regression.classify judges tokens_per_usd higher-is-
+    # better). A silently dropped leg must fail the gate, not read as
+    # "the split's cost unjudged"
+    "disagg.tokens_per_usd_ratio",
 )
 
 

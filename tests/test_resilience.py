@@ -195,9 +195,97 @@ class TestAdmission:
             t.join(timeout=30)
         with lock:
             assert sorted(outcomes) == ["queue_full"] * 5 + ["served"] * 5
-        child = gate.reject_counter.labels(reason="queue_full")
+        child = gate.reject_counter.labels(reason="queue_full",
+                                           tenant="__other__")
         assert child.value == 5
         assert gate.active == 0 and gate.waiting == 0
+
+    def test_fair_share_displaces_the_hog_tenants_newest_waiter(self):
+        """One tenant holding every slot AND every queue position cannot
+        lock a second tenant out: the under-share arrival displaces the
+        hog's newest waiter (shed reason="fair_share"), keeping shed
+        attribution on the tenant that caused the pressure."""
+        gate = AdmissionController(max_concurrency=2, max_queue=2)
+        reg = obs_metrics.MetricsRegistry()
+        gate.reject_counter = reg.labeled_counter(
+            "rag_admission_rejected_total"
+        )
+        hold = threading.Event()
+        outcomes = []
+        lock = threading.Lock()
+
+        def run(tenant):
+            try:
+                with gate.admit(tenant=tenant):
+                    hold.wait(timeout=30)
+                with lock:
+                    outcomes.append((tenant, "served"))
+            except AdmissionRejected as e:
+                with lock:
+                    outcomes.append((tenant, e.reason))
+
+        hogs = [threading.Thread(target=run, args=("hog",)) for _ in range(4)]
+        for t in hogs:
+            t.start()
+        for _ in range(300):  # settle: 2 hog active + 2 hog queued
+            if gate.active == 2 and gate.waiting == 2:
+                break
+            time.sleep(0.01)
+        assert gate.active == 2 and gate.waiting == 2
+        small = threading.Thread(target=run, args=("small",))
+        small.start()
+        for _ in range(300):  # the displaced hog waiter sheds
+            with lock:
+                shed = [o for o in outcomes if o == ("hog", "fair_share")]
+            if shed:
+                break
+            time.sleep(0.01)
+        with lock:
+            assert ("hog", "fair_share") in outcomes
+        hold.set()
+        for t in hogs + [small]:
+            t.join(timeout=30)
+        with lock:
+            assert ("small", "served") in outcomes
+            assert outcomes.count(("hog", "fair_share")) == 1
+            assert outcomes.count(("hog", "served")) == 3
+        child = gate.reject_counter.labels(reason="fair_share", tenant="hog")
+        assert child.value == 1
+        assert gate.active == 0 and gate.waiting == 0
+
+    def test_over_share_arrival_cannot_displace(self):
+        """The displacing tenant must itself be within fair share: a
+        FIFTH request from the hog (share = 4/1 = 4, its own count 5)
+        sheds plain queue_full — fair-share never helps a hog cut its
+        own line."""
+        gate = AdmissionController(max_concurrency=2, max_queue=2)
+        hold = threading.Event()
+        errs = []
+        lock = threading.Lock()
+
+        def run():
+            try:
+                with gate.admit(tenant="hog"):
+                    hold.wait(timeout=30)
+            except AdmissionRejected as e:
+                with lock:
+                    errs.append(e.reason)
+
+        hogs = [threading.Thread(target=run) for _ in range(4)]
+        for t in hogs:
+            t.start()
+        for _ in range(300):
+            if gate.active == 2 and gate.waiting == 2:
+                break
+            time.sleep(0.01)
+        with pytest.raises(AdmissionRejected) as ei:
+            with gate.admit(tenant="hog"):
+                pass
+        assert ei.value.reason == "queue_full"
+        hold.set()
+        for t in hogs:
+            t.join(timeout=30)
+        assert errs == []
 
     def test_rejection_contract(self):
         gate = AdmissionController(max_concurrency=1, max_queue=0,
@@ -451,6 +539,83 @@ class TestResetRecovery:
             assert breaker.open
         finally:
             sched.shutdown()
+
+
+class TestMigrationChaos:
+    """ISSUE 20 chaos contract: a fault INSIDE the migration import's
+    donated region resets the decode-role engine (EngineStateLost); the
+    scheduler re-prefills the packet's prompt + already-emitted tokens
+    there, so the client stream stays byte-identical to a unified run —
+    seeded, not just greedy, because every draw is (seed, position)
+    keyed — and NEITHER engine leaks a block (the prefill engine already
+    released the row at export; the decode engine's reset returns the
+    partially-donated blocks)."""
+
+    PAGED = EngineConfig(
+        prompt_buckets=(16, 32), max_batch_size=4, max_seq_len=64,
+        kv_paged=True, kv_block_size=16,
+    )
+    PROMPTS = [[5, 6, 7, 8, 9, 10, 11], [12, 13, 14], [3] * 20]
+
+    def test_mid_migration_reset_recovers_byte_identical(self, tiny):
+        import dataclasses
+
+        cfg, params, _ = tiny
+        seeded = SamplingConfig(do_sample=True, temperature=0.8,
+                                max_new_tokens=8)
+        uni = ContinuousScheduler(
+            ContinuousEngine(cfg, params, sampling=seeded,
+                             engine_config=self.PAGED, dtypes=FP32),
+            retry_backoff_s=0.0,
+        )
+        try:
+            base = [uni.submit(p, seed=50 + i)
+                    for i, p in enumerate(self.PROMPTS)]
+        finally:
+            uni.shutdown()
+        pre = ContinuousScheduler(
+            ContinuousEngine(
+                cfg, params, sampling=seeded,
+                engine_config=dataclasses.replace(
+                    self.PAGED, pool_role="prefill"
+                ),
+                dtypes=FP32,
+            ),
+            retry_backoff_s=0.0,
+        )
+        dec = ContinuousScheduler(
+            ContinuousEngine(
+                cfg, params, sampling=seeded,
+                engine_config=dataclasses.replace(
+                    self.PAGED, pool_role="decode"
+                ),
+                dtypes=FP32,
+            ),
+            retry_backoff_s=0.0,
+        )
+        try:
+            got = []
+            for i, p in enumerate(self.PROMPTS):
+                if i == 1:  # fault fires mid-import, inside donation
+                    faults.arm("migrate", times=1)
+                info = {}
+                toks = pre.submit(p, seed=50 + i, info=info, timeout=120)
+                pkt = info.get("migrate_packet")
+                got.append(
+                    dec.submit_migrated(pkt, timeout=120)
+                    if pkt is not None else toks
+                )
+            assert faults.armed() == {}, "migrate fault never fired"
+            assert got == base
+            assert pre.engine.kv_pool.blocks_in_use() == 0, (
+                pre.engine.kv_pool.stats()
+            )
+            assert dec.engine.kv_pool.blocks_in_use() == 0, (
+                dec.engine.kv_pool.stats()
+            )
+        finally:
+            pre.shutdown()
+            dec.shutdown()
 
 
 class TestSchedulerLifecycle:
